@@ -1,0 +1,19 @@
+"""racon-tpu: TPU-native genome-assembly polishing framework.
+
+From-scratch rebuild of the capabilities of ahehn-nv/racon-gpu (racon
+v1.4.15 + CUDA offload) with a TPU-first architecture:
+
+* host pipeline (parsing, windowing, stitching) in Python with native C++
+  compute engines for the CPU fallback path,
+* the two DP hot loops -- batched overlap alignment and batched per-window
+  POA consensus -- as fixed-shape, bucketed JAX/XLA kernels sharded over a
+  TPU mesh (see ``racon_tpu.tpu``),
+* the CPU path (edlib/spoa-equivalent engines in ``racon_tpu/native``) as
+  the always-available fallback and accuracy oracle, mirroring the
+  reference's CUDA->CPU degradation contract
+  (reference: src/cuda/cudapolisher.cpp:357-386).
+"""
+
+__version__ = "0.1.0"
+
+from racon_tpu.core.polisher import PolisherType, create_polisher  # noqa: F401

@@ -1,0 +1,202 @@
+"""Command-line interface (reference: src/main.cpp).
+
+Same contract as racon: three positional inputs (sequences, overlaps,
+target sequences), polished FASTA on stdout, and the same option set with
+the CUDA flags mirrored as TPU flags:
+
+  racon:  -c/--cudapoa-batches, -b/--cuda-banded-alignment,
+          --cudaaligner-batches     (src/main.cpp:35-38)
+  here:   -c/--tpupoa-batches,  -b/--tpu-banded-alignment,
+          --tpualigner-batches
+
+``-c`` keeps racon's optional-argument behaviour (bare -c means 1,
+src/main.cpp:111-123).  ``-q -1`` disables the quality filter (any
+negative threshold always passes).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from racon_tpu import __version__
+from racon_tpu.core.overlap import InvalidInputError
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.io.parsers import UnsupportedFormatError
+
+USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequences>
+
+    #default output is stdout
+    <sequences>
+        input file in FASTA/FASTQ format (can be compressed with gzip)
+        containing sequences used for correction
+    <overlaps>
+        input file in MHAP/PAF/SAM format (can be compressed with gzip)
+        containing overlaps between sequences and target sequences
+    <target sequences>
+        input file in FASTA/FASTQ format (can be compressed with gzip)
+        containing sequences which will be corrected
+
+    options:
+        -u, --include-unpolished
+            output unpolished target sequences
+        -f, --fragment-correction
+            perform fragment correction instead of contig polishing
+            (overlaps file should contain dual/self overlaps!)
+        -w, --window-length <int>
+            default: 500
+            size of window on which POA is performed
+        -q, --quality-threshold <float>
+            default: 10.0
+            threshold for average base quality of windows used in POA
+        -e, --error-threshold <float>
+            default: 0.3
+            maximum allowed error rate used for filtering overlaps
+        --no-trimming
+            disables consensus trimming at window ends
+        -m, --match <int>
+            default: 3
+            score for matching bases
+        -x, --mismatch <int>
+            default: -5
+            score for mismatching bases
+        -g, --gap <int>
+            default: -4
+            gap penalty (must be negative)
+        -t, --threads <int>
+            default: 1
+            number of threads
+        --version
+            prints the version number
+        -h, --help
+            prints the usage
+        -c, --tpupoa-batches <int>
+            default: 0
+            number of batches for TPU accelerated polishing
+        -b, --tpu-banded-alignment
+            use banding approximation for alignment on TPU
+        --tpualigner-batches <int>
+            default: 0
+            number of batches for TPU accelerated alignment
+"""
+
+
+def parse_args(argv):
+    """getopt-style parse preserving racon's -c optional-arg quirk."""
+    opts = {
+        "window_length": 500, "quality_threshold": 10.0,
+        "error_threshold": 0.3, "trim": True, "match": 3, "mismatch": -5,
+        "gap": -4, "threads": 1, "type": PolisherType.kC,
+        "drop_unpolished": True, "tpu_poa_batches": 0,
+        "tpu_banded_alignment": False, "tpu_aligner_batches": 0,
+    }
+    positionals = []
+    i = 0
+    n = len(argv)
+
+    def take_value(flag):
+        nonlocal i
+        i += 1
+        if i >= n:
+            print(f"[racon_tpu::] error: missing argument for {flag}!",
+                  file=sys.stderr)
+            raise SystemExit(1)
+        return argv[i]
+
+    while i < n:
+        a = argv[i]
+        if a in ("-u", "--include-unpolished"):
+            opts["drop_unpolished"] = False
+        elif a in ("-f", "--fragment-correction"):
+            opts["type"] = PolisherType.kF
+        elif a in ("-w", "--window-length"):
+            opts["window_length"] = int(take_value(a))
+        elif a.startswith("--window-length="):
+            opts["window_length"] = int(a.split("=", 1)[1])
+        elif a in ("-q", "--quality-threshold"):
+            opts["quality_threshold"] = float(take_value(a))
+        elif a.startswith("--quality-threshold="):
+            opts["quality_threshold"] = float(a.split("=", 1)[1])
+        elif a in ("-e", "--error-threshold"):
+            opts["error_threshold"] = float(take_value(a))
+        elif a.startswith("--error-threshold="):
+            opts["error_threshold"] = float(a.split("=", 1)[1])
+        elif a in ("-T", "--no-trimming"):
+            opts["trim"] = False
+        elif a in ("-m", "--match"):
+            opts["match"] = int(take_value(a))
+        elif a in ("-x", "--mismatch"):
+            opts["mismatch"] = int(take_value(a))
+        elif a in ("-g", "--gap"):
+            opts["gap"] = int(take_value(a))
+        elif a in ("-t", "--threads"):
+            opts["threads"] = int(take_value(a))
+        elif a in ("-c", "--tpupoa-batches", "--cudapoa-batches"):
+            # optional argument: bare -c means 1 (src/main.cpp:111-123)
+            opts["tpu_poa_batches"] = 1
+            if i + 1 < n and argv[i + 1] and not argv[i + 1].startswith("-"):
+                i += 1
+                opts["tpu_poa_batches"] = int(argv[i])
+        elif a.startswith("--tpupoa-batches="):
+            opts["tpu_poa_batches"] = int(a.split("=", 1)[1])
+        elif a in ("-b", "--tpu-banded-alignment", "--cuda-banded-alignment"):
+            opts["tpu_banded_alignment"] = True
+        elif a in ("--tpualigner-batches", "--cudaaligner-batches"):
+            opts["tpu_aligner_batches"] = int(take_value(a))
+        elif a.startswith("--tpualigner-batches="):
+            opts["tpu_aligner_batches"] = int(a.split("=", 1)[1])
+        elif a == "--version":
+            print(__version__)
+            raise SystemExit(0)
+        elif a in ("-h", "--help"):
+            print(USAGE, end="")
+            raise SystemExit(0)
+        elif a.startswith("-") and a != "-":
+            print(f"[racon_tpu::] error: unknown option {a}!",
+                  file=sys.stderr)
+            raise SystemExit(1)
+        else:
+            positionals.append(a)
+        i += 1
+
+    return opts, positionals
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        opts, inputs = parse_args(argv)
+    except ValueError as exc:
+        print(f"[racon_tpu::] error: invalid option value ({exc})!",
+              file=sys.stderr)
+        raise SystemExit(1)
+
+    if len(inputs) < 3:
+        print("[racon_tpu::] error: missing input file(s)!", file=sys.stderr)
+        print(USAGE, end="", file=sys.stderr)
+        raise SystemExit(1)
+
+    try:
+        polisher = create_polisher(
+            inputs[0], inputs[1], inputs[2], opts["type"],
+            opts["window_length"], opts["quality_threshold"],
+            opts["error_threshold"], opts["trim"], opts["match"],
+            opts["mismatch"], opts["gap"], opts["threads"],
+            opts["tpu_poa_batches"], opts["tpu_banded_alignment"],
+            opts["tpu_aligner_batches"])
+        polisher.initialize()
+        polished = polisher.polish(opts["drop_unpolished"])
+        polisher.total_log()
+    except (InvalidInputError, UnsupportedFormatError, FileNotFoundError) \
+            as exc:
+        print(f"[racon_tpu::] error: {exc}", file=sys.stderr)
+        raise SystemExit(1)
+
+    out = sys.stdout.buffer
+    for seq in polished:
+        out.write(b">" + seq.name.encode() + b"\n" + seq.data + b"\n")
+    out.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,281 @@
+"""Overlap domain object.
+
+Mirrors racon's Overlap (reference: src/overlap.cpp): three format
+constructors (MHAP/PAF/SAM), name/id resolution against the loaded
+sequence set (``transmute``), and per-window breaking-point extraction by
+walking the alignment CIGAR (``find_breaking_points_from_cigar``,
+reference: src/overlap.cpp:226-292).  The CIGAR walk is vectorised with
+numpy instead of the reference's per-base loop.
+
+When an overlap record carries no CIGAR (PAF/MHAP), one is produced by a
+global alignment of the query span vs the target span -- on the CPU via
+the native edlib-equivalent engine, or in bulk on the TPU by the batched
+aligner (racon_tpu.tpu.aligner), which pre-fills ``cigar`` exactly like
+the reference's CUDABatchAligner (src/cuda/cudaaligner.cpp:89-103).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence as Seq, Tuple
+
+import numpy as np
+
+_CIGAR_RE = re.compile(rb"(\d+)([MIDNSHP=X])")
+
+
+class InvalidInputError(RuntimeError):
+    """Unrecoverable input inconsistency (reference exits(1))."""
+
+
+class Overlap:
+    __slots__ = ("q_name", "q_id", "q_begin", "q_end", "q_length",
+                 "t_name", "t_id", "t_begin", "t_end", "t_length",
+                 "strand", "length", "error", "cigar", "is_valid",
+                 "is_transmuted", "breaking_points")
+
+    def __init__(self):
+        self.q_name: Optional[str] = None
+        self.q_id: int = 0
+        self.q_begin = 0
+        self.q_end = 0
+        self.q_length = 0
+        self.t_name: Optional[str] = None
+        self.t_id: int = 0
+        self.t_begin = 0
+        self.t_end = 0
+        self.t_length = 0
+        self.strand = False
+        self.length = 0
+        self.error = 0.0
+        self.cigar: str = ""
+        self.is_valid = True
+        self.is_transmuted = False
+        self.breaking_points: Optional[np.ndarray] = None  # (2k, 2) [t, q]
+
+    # -- format constructors (reference: src/overlap.cpp:15-108) -----------
+
+    @classmethod
+    def from_mhap(cls, a_id: int, b_id: int, a_rc: int, a_begin: int,
+                  a_end: int, a_length: int, b_rc: int, b_begin: int,
+                  b_end: int, b_length: int) -> "Overlap":
+        o = cls()
+        o.q_id = a_id - 1          # MHAP ids are 1-based
+        o.q_begin, o.q_end, o.q_length = a_begin, a_end, a_length
+        o.t_id = b_id - 1
+        o.t_begin, o.t_end, o.t_length = b_begin, b_end, b_length
+        o.strand = bool(a_rc ^ b_rc)
+        o._set_span_error()
+        return o
+
+    @classmethod
+    def from_paf(cls, q_name: str, q_length: int, q_begin: int, q_end: int,
+                 orientation: str, t_name: str, t_length: int, t_begin: int,
+                 t_end: int) -> "Overlap":
+        o = cls()
+        o.q_name, o.q_length, o.q_begin, o.q_end = q_name, q_length, q_begin, q_end
+        o.t_name, o.t_length, o.t_begin, o.t_end = t_name, t_length, t_begin, t_end
+        o.strand = orientation == "-"
+        o._set_span_error()
+        return o
+
+    @classmethod
+    def from_sam(cls, q_name: str, flag: int, t_name: str, t_begin: int,
+                 cigar: str) -> "Overlap":
+        o = cls()
+        o.q_name, o.t_name = q_name, t_name
+        o.t_begin = t_begin - 1    # SAM POS is 1-based
+        o.strand = bool(flag & 0x10)
+        o.is_valid = not (flag & 0x4)
+        o.cigar = cigar
+        if len(cigar) < 2 and o.is_valid:
+            raise InvalidInputError("missing alignment from SAM object")
+        ops = _CIGAR_RE.findall(cigar.encode())
+        q_aln = t_aln = q_clip = 0
+        for num, op in ops:
+            n = int(num)
+            if op in b"M=X":
+                q_aln += n
+                t_aln += n
+            elif op == b"I":
+                q_aln += n
+            elif op in b"DN":
+                t_aln += n
+            elif op in b"SH":
+                q_clip += n
+        # a leading clip, if any, is the query start offset
+        # (reference: src/overlap.cpp:60-69)
+        q_begin = 0
+        for num, op in ops:
+            if op in b"SH":
+                q_begin = int(num)
+                break
+            if op in b"M=XIDNP":
+                break
+        o.q_begin = q_begin
+        o.q_end = q_begin + q_aln
+        o.q_length = q_clip + q_aln
+        if o.strand:
+            o.q_begin, o.q_end = o.q_length - o.q_end, o.q_length - o.q_begin
+        o.t_end = o.t_begin + t_aln
+        o.length = max(q_aln, t_aln)
+        o.error = (1 - min(q_aln, t_aln) / o.length) if o.length else 0.0
+        return o
+
+    def _set_span_error(self) -> None:
+        q_span = self.q_end - self.q_begin
+        t_span = self.t_end - self.t_begin
+        self.length = max(q_span, t_span)
+        self.error = (1 - min(q_span, t_span) / self.length) if self.length \
+            else 0.0
+
+    # -- id resolution (reference: src/overlap.cpp:129-177) -----------------
+
+    def transmute(self, sequences: Seq, name_to_id: Dict[str, int],
+                  id_to_id: Dict[int, int]) -> None:
+        if not self.is_valid or self.is_transmuted:
+            return
+
+        if self.q_name is not None:
+            qid = name_to_id.get(self.q_name + "q")
+            if qid is None:
+                self.is_valid = False
+                return
+            self.q_id = qid
+            self.q_name = None
+        else:
+            qid = id_to_id.get(self.q_id << 1 | 0)
+            if qid is None:
+                self.is_valid = False
+                return
+            self.q_id = qid
+
+        if self.q_length != len(sequences[self.q_id].data):
+            raise InvalidInputError(
+                "unequal lengths in sequence and overlap file for sequence "
+                f"{sequences[self.q_id].name}")
+
+        if self.t_name is not None:
+            tid = name_to_id.get(self.t_name + "t")
+            if tid is None:
+                self.is_valid = False
+                return
+            self.t_id = tid
+            self.t_name = None
+        else:
+            tid = id_to_id.get(self.t_id << 1 | 1)
+            if tid is None:
+                self.is_valid = False
+                return
+            self.t_id = tid
+
+        if self.t_length != 0 and \
+                self.t_length != len(sequences[self.t_id].data):
+            raise InvalidInputError(
+                "unequal lengths in target and overlap file for target "
+                f"{sequences[self.t_id].name}")
+
+        # SAM records learn the target length here
+        self.t_length = len(sequences[self.t_id].data)
+        self.is_transmuted = True
+
+    # -- alignment slices ---------------------------------------------------
+
+    def query_span(self, sequences: Seq) -> bytes:
+        """Strand-aware query slice (reference: src/overlap.cpp:193-194)."""
+        seq = sequences[self.q_id]
+        if not self.strand:
+            return seq.data[self.q_begin:self.q_end]
+        rc = seq.reverse_complement
+        return rc[self.q_length - self.q_end:self.q_length - self.q_begin]
+
+    def target_span(self, sequences: Seq) -> bytes:
+        return sequences[self.t_id].data[self.t_begin:self.t_end]
+
+    # -- breaking points ----------------------------------------------------
+
+    def find_breaking_points(self, sequences: Seq, window_length: int,
+                             aligner=None) -> None:
+        """Produce (target, query) window breaking points.
+
+        ``aligner(q: bytes, t: bytes) -> str`` supplies a CIGAR when the
+        record has none (reference uses edlib, src/overlap.cpp:205-224).
+        """
+        if not self.is_transmuted:
+            raise InvalidInputError("overlap is not transmuted")
+        if self.breaking_points is not None:
+            return
+        if not self.cigar:
+            if aligner is None:
+                raise InvalidInputError(
+                    "overlap has no CIGAR and no aligner was provided")
+            self.cigar = aligner(self.query_span(sequences),
+                                 self.target_span(sequences))
+        self.find_breaking_points_from_cigar(window_length)
+        self.cigar = ""
+
+    def find_breaking_points_from_cigar(self, window_length: int) -> None:
+        """Vectorised CIGAR walk (reference: src/overlap.cpp:226-292).
+
+        Emits, for every window of the target the alignment spans, the
+        (t, q) coordinates of the first match in the window and one past
+        the last match.
+        """
+        w = window_length
+        ops = _CIGAR_RE.findall(self.cigar.encode())
+        if not ops:
+            self.breaking_points = np.empty((0, 2), dtype=np.int64)
+            return
+
+        lengths = np.array([int(n) for n, _ in ops], dtype=np.int64)
+        codes = np.array([b"MIDNSHP=X".index(op) for _, op in ops],
+                         dtype=np.int64)
+        # advance masks per op: M(0) = X(8) = '='(7) advance both;
+        # I(1) query; D(2)/N(3) target; S/H/P consume nothing.
+        advances_t = np.isin(codes, (0, 2, 3, 7, 8))
+        advances_q = np.isin(codes, (0, 1, 7, 8))
+        matches = np.isin(codes, (0, 7, 8))
+        keep = advances_t | advances_q
+        lengths, advances_t, advances_q, matches = (
+            lengths[keep], advances_t[keep], advances_q[keep], matches[keep])
+        if lengths.size == 0:
+            self.breaking_points = np.empty((0, 2), dtype=np.int64)
+            return
+
+        t_adv = np.repeat(advances_t, lengths)
+        q_adv = np.repeat(advances_q, lengths)
+        is_match = np.repeat(matches, lengths)
+
+        q_start = (self.q_length - self.q_end if self.strand
+                   else self.q_begin) - 1
+        t_pos = self.t_begin - 1 + np.cumsum(t_adv)
+        q_pos = q_start + np.cumsum(q_adv)
+
+        boundary = t_adv & (
+            (((t_pos + 1) % w == 0) & (t_pos < self.t_end - 1)) |
+            (t_pos == self.t_end - 1))
+        n_boundaries = int(boundary.sum())
+        if n_boundaries == 0:
+            self.breaking_points = np.empty((0, 2), dtype=np.int64)
+            return
+
+        seg_id = np.cumsum(boundary) - boundary  # boundary col closes its seg
+        m_idx = np.flatnonzero(is_match)
+        if m_idx.size == 0:
+            self.breaking_points = np.empty((0, 2), dtype=np.int64)
+            return
+        m_seg = seg_id[m_idx]
+        segs = np.arange(n_boundaries)
+        lo = np.searchsorted(m_seg, segs, side="left")
+        hi = np.searchsorted(m_seg, segs, side="right")
+        has_match = lo < hi
+        lo, hi = lo[has_match], hi[has_match]
+        first_cols = m_idx[lo]
+        last_cols = m_idx[hi - 1]
+
+        points = np.empty((2 * first_cols.size, 2), dtype=np.int64)
+        points[0::2, 0] = t_pos[first_cols]
+        points[0::2, 1] = q_pos[first_cols]
+        points[1::2, 0] = t_pos[last_cols] + 1
+        points[1::2, 1] = q_pos[last_cols] + 1
+        self.breaking_points = points

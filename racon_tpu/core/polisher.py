@@ -1,0 +1,392 @@
+"""Polisher: the pipeline orchestrator (reference: src/polisher.{hpp,cpp}).
+
+Drives parse -> overlap filtering -> breaking points -> windowing ->
+batched consensus -> stitching.  The accelerator seam is the same as the
+reference's (src/polisher.hpp:55,74): two overridable methods,
+``find_overlap_breaking_points`` and ``generate_consensuses``; the
+TPUPolisher subclass (racon_tpu.tpu.polisher) overrides both to run the
+batched device kernels with CPU fallback for whatever the device path
+rejects, exactly like CUDAPolisher (src/cuda/cudapolisher.cpp).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import enum
+from typing import Dict, List, Optional
+
+from racon_tpu.core.overlap import InvalidInputError, Overlap
+from racon_tpu.core.sequence import Sequence
+from racon_tpu.core.window import Window, WindowType
+from racon_tpu.io.parsers import (create_overlap_parser,
+                                  create_sequence_parser)
+from racon_tpu.ops import cpu
+from racon_tpu.utils.logger import Logger
+
+CHUNK_SIZE = 1024 * 1024 * 1024  # reference kChunkSize (polisher.cpp:26)
+
+
+class PolisherType(enum.Enum):
+    kC = 0  # contig polishing
+    kF = 1  # fragment (read) error correction
+
+
+def create_polisher(sequences_path: str, overlaps_path: str,
+                    target_path: str, type_: PolisherType,
+                    window_length: int, quality_threshold: float,
+                    error_threshold: float, trim: bool, match: int,
+                    mismatch: int, gap: int, num_threads: int,
+                    tpu_poa_batches: int = 0,
+                    tpu_banded_alignment: bool = False,
+                    tpu_aligner_batches: int = 0) -> "Polisher":
+    """Factory mirroring racon::createPolisher (src/polisher.cpp:55-159).
+
+    TPU offload is selected per stage by ``tpu_poa_batches`` /
+    ``tpu_aligner_batches`` the same way the reference gates CUDA
+    offload by --cudapoa-batches / --cudaaligner-batches.
+    """
+    if not isinstance(type_, PolisherType):
+        raise InvalidInputError("invalid polisher type!")
+    if window_length == 0:
+        raise InvalidInputError("invalid window length!")
+
+    sparser = create_sequence_parser(sequences_path)
+    oparser = create_overlap_parser(overlaps_path)
+    tparser = create_sequence_parser(target_path)
+
+    if tpu_poa_batches > 0 or tpu_aligner_batches > 0:
+        try:
+            from racon_tpu.tpu.polisher import TPUPolisher
+        except ImportError as exc:
+            raise InvalidInputError(
+                f"TPU support is not available ({exc})") from exc
+        return TPUPolisher(sparser, oparser, tparser, type_, window_length,
+                           quality_threshold, error_threshold, trim, match,
+                           mismatch, gap, num_threads, tpu_poa_batches,
+                           tpu_banded_alignment, tpu_aligner_batches)
+    return Polisher(sparser, oparser, tparser, type_, window_length,
+                    quality_threshold, error_threshold, trim, match,
+                    mismatch, gap, num_threads)
+
+
+class Polisher:
+    def __init__(self, sparser, oparser, tparser, type_: PolisherType,
+                 window_length: int, quality_threshold: float,
+                 error_threshold: float, trim: bool, match: int,
+                 mismatch: int, gap: int, num_threads: int):
+        self.sparser = sparser
+        self.oparser = oparser
+        self.tparser = tparser
+        self.type = type_
+        self.window_length = window_length
+        self.quality_threshold = quality_threshold
+        self.error_threshold = error_threshold
+        self.trim = trim
+        self.match, self.mismatch, self.gap = match, mismatch, gap
+        self.num_threads = max(1, num_threads)
+
+        self.sequences: List[Sequence] = []
+        self.windows: List[Window] = []
+        self.targets_coverages: List[int] = []
+        self.dummy_quality = b"!" * window_length
+        self.engine = cpu.PoaEngine(match, mismatch, gap)
+        self.logger = Logger()
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.num_threads)
+
+    # ------------------------------------------------------------------
+    # initialize: reference src/polisher.cpp:191-459
+    # ------------------------------------------------------------------
+
+    def initialize(self) -> None:
+        if self.windows:
+            print("[racon_tpu::Polisher::initialize] warning: object "
+                  "already initialized!")
+            return
+
+        self.logger.log()
+        self.tparser.reset()
+        self.tparser.parse(self.sequences, -1)
+        targets_size = len(self.sequences)
+        if targets_size == 0:
+            raise InvalidInputError("empty target sequences set!")
+
+        name_to_id: Dict[str, int] = {}
+        id_to_id: Dict[int, int] = {}
+        for i in range(targets_size):
+            name_to_id[self.sequences[i].name + "t"] = i
+            id_to_id[i << 1 | 1] = i
+
+        has_name = [True] * targets_size
+        has_data = [True] * targets_size
+        has_reverse_data = [False] * targets_size
+
+        self.logger.log("[racon_tpu::Polisher::initialize] loaded target "
+                        "sequences")
+        self.logger.log()
+
+        # reads, with duplicate read-as-target dedup
+        # (reference: src/polisher.cpp:228-263)
+        sequences_size = 0
+        total_sequences_length = 0
+        self.sparser.reset()
+        while True:
+            chunk_start = len(self.sequences)
+            status = self.sparser.parse(self.sequences, CHUNK_SIZE)
+            kept: List[Sequence] = []
+            n_dropped = 0
+            for i in range(chunk_start, len(self.sequences)):
+                seq = self.sequences[i]
+                total_sequences_length += len(seq.data)
+                existing = name_to_id.get(seq.name + "t")
+                if existing is not None:
+                    if len(seq.data) != \
+                            len(self.sequences[existing].data) or \
+                            len(seq.quality) != \
+                            len(self.sequences[existing].quality):
+                        raise InvalidInputError(
+                            f"duplicate sequence {seq.name} with unequal "
+                            "data")
+                    name_to_id[seq.name + "q"] = existing
+                    id_to_id[sequences_size << 1 | 0] = existing
+                    n_dropped += 1
+                else:
+                    new_id = i - n_dropped
+                    name_to_id[seq.name + "q"] = new_id
+                    id_to_id[sequences_size << 1 | 0] = new_id
+                    kept.append(seq)
+                sequences_size += 1
+            del self.sequences[chunk_start:]
+            self.sequences.extend(kept)
+            if not status:
+                break
+
+        if sequences_size == 0:
+            raise InvalidInputError("empty sequences set!")
+
+        n_total = len(self.sequences)
+        has_name += [False] * (n_total - targets_size)
+        has_data += [False] * (n_total - targets_size)
+        has_reverse_data += [False] * (n_total - targets_size)
+
+        window_type = (WindowType.NGS
+                       if total_sequences_length / sequences_size <= 1000
+                       else WindowType.TGS)
+
+        self.logger.log("[racon_tpu::Polisher::initialize] loaded sequences")
+        self.logger.log()
+
+        overlaps = self._load_overlaps(name_to_id, id_to_id, has_data,
+                                       has_reverse_data)
+        if not overlaps:
+            raise InvalidInputError("empty overlap set!")
+
+        self.logger.log("[racon_tpu::Polisher::initialize] loaded overlaps")
+        self.logger.log()
+
+        # materialise reverse complements in the pool
+        # (reference: src/polisher.cpp:368-377)
+        list(self._pool.map(
+            lambda args: args[0].transmute(*args[1:]),
+            [(s, has_name[j], has_data[j], has_reverse_data[j])
+             for j, s in enumerate(self.sequences)]))
+
+        self.find_overlap_breaking_points(overlaps)
+
+        self.logger.log()
+        self._build_windows(targets_size, window_type, overlaps)
+        self.logger.log("[racon_tpu::Polisher::initialize] transformed data "
+                        "into windows")
+
+    def _load_overlaps(self, name_to_id, id_to_id, has_data,
+                       has_reverse_data) -> List[Overlap]:
+        """Stream overlaps, transmute, and filter (polisher.cpp:283-354)."""
+        overlaps: List[Optional[Overlap]] = []
+
+        def remove_invalid(begin: int, end: int) -> None:
+            for i in range(begin, end):
+                if overlaps[i] is None:
+                    continue
+                o = overlaps[i]
+                if o.error > self.error_threshold or o.q_id == o.t_id:
+                    overlaps[i] = None
+                    continue
+                if self.type == PolisherType.kC:
+                    # keep only the longest overlap per query
+                    for j in range(i + 1, end):
+                        if overlaps[j] is None:
+                            continue
+                        if o.length > overlaps[j].length:
+                            overlaps[j] = None
+                        else:
+                            overlaps[i] = None
+                            break
+
+        self.oparser.reset()
+        l = 0
+        while True:
+            status = self.oparser.parse(overlaps, CHUNK_SIZE)
+            c = l
+            for i in range(l, len(overlaps)):
+                overlaps[i].transmute(self.sequences, name_to_id, id_to_id)
+                if not overlaps[i].is_valid:
+                    overlaps[i] = None
+                    continue
+                while overlaps[c] is None:
+                    c += 1
+                if overlaps[c].q_id != overlaps[i].q_id:
+                    remove_invalid(c, i)
+                    c = i
+            if not status:
+                remove_invalid(c, len(overlaps))
+                c = len(overlaps)
+
+            for i in range(l, c):
+                if overlaps[i] is None:
+                    continue
+                if overlaps[i].strand:
+                    has_reverse_data[overlaps[i].q_id] = True
+                else:
+                    has_data[overlaps[i].q_id] = True
+
+            # compact nulls from l onward (reference shrinkToFit,
+            # src/polisher.cpp:348-349)
+            n_removed = sum(1 for o in overlaps[l:] if o is None)
+            overlaps[l:] = [o for o in overlaps[l:] if o is not None]
+            l = c - n_removed
+            if not status:
+                break
+        return overlaps  # type: ignore[return-value]
+
+    # ------------------------------------------------------------------
+    # accelerator seam #1 (reference: src/polisher.cpp:461-483)
+    # ------------------------------------------------------------------
+
+    def find_overlap_breaking_points(self, overlaps: List[Overlap]) -> None:
+        def work(o: Overlap) -> None:
+            o.find_breaking_points(self.sequences, self.window_length,
+                                   aligner=cpu.align)
+
+        futures = [self._pool.submit(work, o) for o in overlaps]
+        step = len(futures) // 20
+        for i, f in enumerate(futures):
+            f.result()
+            if step != 0 and (i + 1) % step == 0 and (i + 1) // step < 20:
+                self.logger.bar("[racon_tpu::Polisher::initialize] aligning "
+                                "overlaps")
+        if step != 0:
+            self.logger.bar("[racon_tpu::Polisher::initialize] aligning "
+                            "overlaps")
+        else:
+            self.logger.log("[racon_tpu::Polisher::initialize] aligned "
+                            "overlaps")
+
+    # ------------------------------------------------------------------
+    # windowing (reference: src/polisher.cpp:383-456)
+    # ------------------------------------------------------------------
+
+    def _build_windows(self, targets_size: int, window_type: WindowType,
+                       overlaps: List[Overlap]) -> None:
+        id_to_first_window_id = [0] * (targets_size + 1)
+        for i in range(targets_size):
+            data = self.sequences[i].data
+            quality = self.sequences[i].quality
+            k = 0
+            for j in range(0, len(data), self.window_length):
+                length = min(j + self.window_length, len(data)) - j
+                q = (self.dummy_quality[:length] if not quality
+                     else quality[j:j + length])
+                self.windows.append(Window(i, k, window_type,
+                                           data[j:j + length], q))
+                k += 1
+            id_to_first_window_id[i + 1] = id_to_first_window_id[i] + k
+
+        self.targets_coverages = [0] * targets_size
+
+        w = self.window_length
+        for o in overlaps:
+            self.targets_coverages[o.t_id] += 1
+            sequence = self.sequences[o.q_id]
+            points = o.breaking_points
+            if points is None:
+                continue
+            # check the stored slot: reverse_quality exists iff transmute
+            # materialised it; the property would create it as a side
+            # effect (reference getter has none, src/sequence.hpp)
+            has_quality = bool(sequence.quality) or \
+                bool(sequence._reverse_quality)
+            quality_src = (sequence.reverse_quality if o.strand
+                           else sequence.quality)
+            data_src = (sequence.reverse_complement if o.strand
+                        else sequence.data)
+            for j in range(0, len(points), 2):
+                t_first, q_first = int(points[j][0]), int(points[j][1])
+                t_last, q_last = int(points[j + 1][0]), int(points[j + 1][1])
+                if q_last - q_first < 0.02 * w:
+                    continue
+                if has_quality and quality_src:
+                    frag_q = quality_src[q_first:q_last]
+                    average_quality = (sum(frag_q) / len(frag_q)) - 33
+                    if average_quality < self.quality_threshold:
+                        continue
+                window_id = id_to_first_window_id[o.t_id] + t_first // w
+                window_start = (t_first // w) * w
+                data = data_src[q_first:q_last]
+                quality = quality_src[q_first:q_last] if quality_src else None
+                self.windows[window_id].add_layer(
+                    data, quality, t_first - window_start,
+                    t_last - window_start - 1)
+            o.breaking_points = None
+
+    # ------------------------------------------------------------------
+    # accelerator seam #2 + polish (reference: src/polisher.cpp:485-547)
+    # ------------------------------------------------------------------
+
+    def generate_consensuses(self) -> List[bool]:
+        """Generate consensus for every window; returns polished flags."""
+        futures = [
+            self._pool.submit(w.generate_consensus, self.engine, self.trim)
+            for w in self.windows]
+        results = []
+        step = len(futures) // 20
+        for i, f in enumerate(futures):
+            results.append(f.result())
+            if step != 0 and (i + 1) % step == 0 and (i + 1) // step < 20:
+                self.logger.bar("[racon_tpu::Polisher::polish] generating "
+                                "consensus")
+        if step != 0:
+            self.logger.bar("[racon_tpu::Polisher::polish] generating "
+                            "consensus")
+        else:
+            self.logger.log("[racon_tpu::Polisher::polish] generated "
+                            "consensus")
+        return results
+
+    def polish(self, drop_unpolished_sequences: bool) -> List[Sequence]:
+        self.logger.log()
+        polished_flags = self.generate_consensuses()
+
+        dst: List[Sequence] = []
+        polished_data = bytearray()
+        num_polished_windows = 0
+        for i, window in enumerate(self.windows):
+            num_polished_windows += 1 if polished_flags[i] else 0
+            polished_data += window.consensus
+            if i == len(self.windows) - 1 or self.windows[i + 1].rank == 0:
+                polished_ratio = num_polished_windows / (window.rank + 1)
+                if not drop_unpolished_sequences or polished_ratio > 0:
+                    tags = "r" if self.type == PolisherType.kF else ""
+                    tags += f" LN:i:{len(polished_data)}"
+                    tags += f" RC:i:{self.targets_coverages[window.id]}"
+                    tags += f" XC:f:{polished_ratio:.6f}"
+                    dst.append(Sequence(
+                        self.sequences[window.id].name + tags,
+                        bytes(polished_data)))
+                num_polished_windows = 0
+                polished_data = bytearray()
+        self.windows = []
+        self.sequences = []
+        return dst
+
+    def total_log(self) -> None:
+        self.logger.total("[racon_tpu::Polisher::] total =")

@@ -1,0 +1,9 @@
+from racon_tpu.io.parsers import (  # noqa: F401
+    FastaParser,
+    FastqParser,
+    MhapParser,
+    PafParser,
+    SamParser,
+    create_sequence_parser,
+    create_overlap_parser,
+)

@@ -1,0 +1,242 @@
+"""Sequence and overlap file parsers (bioparser-equivalent).
+
+Re-provides the functionality racon gets from the vendored ``bioparser``
+library (reference: vendor/bioparser, call sites src/polisher.cpp:86-125):
+gzip-transparent, chunked parsers for FASTA/FASTQ sequence files and
+MHAP/PAF/SAM overlap files.  ``parse(dst, max_bytes)`` appends parsed
+records to ``dst`` and returns True while more data remains, mirroring the
+streaming semantics used by Polisher::initialize (src/polisher.cpp:228-263).
+
+Parsers are format-specific and construct records through the factory
+callables handed to them, the same dependency direction as bioparser's
+friend-constructor injection (reference: src/sequence.hpp:56-57,
+src/overlap.hpp:71-73).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+from typing import Callable, List, Optional
+
+from racon_tpu.core.sequence import Sequence
+from racon_tpu.core.overlap import Overlap
+
+
+def _open(path: str):
+    """Open a possibly-gzipped file in binary mode (zlib-transparent)."""
+    with open(path, "rb") as probe:
+        magic = probe.read(2)
+    if magic == b"\x1f\x8b":
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+class _LineChunkParser:
+    """Base for line-oriented parsers with byte-budget chunking."""
+
+    def __init__(self, path: str):
+        if not os.path.isfile(path):
+            raise FileNotFoundError(path)
+        self.path = path
+        self._fh = None
+
+    def reset(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+        self._fh = _open(self.path)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def _ensure_open(self):
+        if self._fh is None:
+            self.reset()
+        return self._fh
+
+
+class FastaParser(_LineChunkParser):
+    """Multi-line FASTA; records created via Sequence.from_fasta."""
+
+    def __init__(self, path: str):
+        super().__init__(path)
+        self._pending_header: Optional[bytes] = None
+
+    def reset(self) -> None:
+        super().reset()
+        self._pending_header = None
+
+    def parse(self, dst: List[Sequence], max_bytes: int) -> bool:
+        fh = self._ensure_open()
+        budget = max_bytes if max_bytes >= 0 else float("inf")
+        consumed = 0
+        header = self._pending_header
+        self._pending_header = None
+        data_parts: List[bytes] = []
+
+        def flush():
+            if header is not None:
+                dst.append(Sequence.from_fasta(header, b"".join(data_parts)))
+
+        for raw in fh:
+            line = raw.rstrip(b"\r\n")
+            if line.startswith(b">"):
+                if header is not None and consumed >= budget:
+                    # keep record boundaries: stop before a new record once
+                    # over budget (bioparser stops at the first record that
+                    # does not fit; we approximate with >= budget)
+                    flush()
+                    self._pending_header = line[1:]
+                    return True
+                flush()
+                header = line[1:]
+                data_parts = []
+            else:
+                if header is None:
+                    continue
+                data_parts.append(line)
+            consumed += len(raw)
+        flush()
+        return False
+
+
+class FastqParser(_LineChunkParser):
+    """FASTQ with possibly line-wrapped data/quality sections."""
+
+    def parse(self, dst: List[Sequence], max_bytes: int) -> bool:
+        fh = self._ensure_open()
+        budget = max_bytes if max_bytes >= 0 else float("inf")
+        consumed = 0
+        while True:
+            header = fh.readline()
+            if not header:
+                return False
+            consumed += len(header)
+            header = header.rstrip(b"\r\n")
+            if not header.startswith(b"@"):
+                continue
+            data_parts: List[bytes] = []
+            data_len = 0
+            while True:
+                line = fh.readline()
+                if not line:
+                    break
+                consumed += len(line)
+                line = line.rstrip(b"\r\n")
+                if line.startswith(b"+"):
+                    break
+                data_parts.append(line)
+                data_len += len(line)
+            qual_parts: List[bytes] = []
+            qual_len = 0
+            while qual_len < data_len:
+                line = fh.readline()
+                if not line:
+                    break
+                consumed += len(line)
+                line = line.rstrip(b"\r\n")
+                qual_parts.append(line)
+                qual_len += len(line)
+            dst.append(Sequence.from_fastq(header[1:], b"".join(data_parts),
+                                           b"".join(qual_parts)))
+            if consumed >= budget:
+                return True
+
+
+class _OverlapLineParser(_LineChunkParser):
+    record_from_line: Callable[[bytes], Optional[Overlap]]
+
+    def parse(self, dst: List[Overlap], max_bytes: int) -> bool:
+        fh = self._ensure_open()
+        budget = max_bytes if max_bytes >= 0 else float("inf")
+        consumed = 0
+        for raw in fh:
+            line = raw.rstrip(b"\r\n")
+            if not line:
+                continue
+            record = self.record_from_line(line)
+            if record is not None:
+                dst.append(record)
+            consumed += len(raw)
+            if consumed >= budget:
+                return True
+        return False
+
+
+class PafParser(_OverlapLineParser):
+    """PAF: qname qlen qstart qend strand tname tlen tstart tend ..."""
+
+    @staticmethod
+    def record_from_line(line: bytes) -> Optional[Overlap]:
+        f = line.split(b"\t")
+        return Overlap.from_paf(
+            q_name=f[0].decode(), q_length=int(f[1]), q_begin=int(f[2]),
+            q_end=int(f[3]), orientation=f[4].decode(),
+            t_name=f[5].decode(), t_length=int(f[6]), t_begin=int(f[7]),
+            t_end=int(f[8]))
+
+
+class MhapParser(_OverlapLineParser):
+    """MHAP: aid bid jaccard minmers arc abeg aend alen brc bbeg bend blen.
+
+    Ids are 1-based in the file; Overlap.from_mhap subtracts 1
+    (reference: src/overlap.cpp:15-27).
+    """
+
+    @staticmethod
+    def record_from_line(line: bytes) -> Optional[Overlap]:
+        f = line.split()
+        return Overlap.from_mhap(
+            a_id=int(f[0]), b_id=int(f[1]),
+            a_rc=int(f[4]), a_begin=int(f[5]), a_end=int(f[6]),
+            a_length=int(f[7]), b_rc=int(f[8]), b_begin=int(f[9]),
+            b_end=int(f[10]), b_length=int(f[11]))
+
+
+class SamParser(_OverlapLineParser):
+    """SAM alignment lines; headers skipped; unmapped flagged invalid."""
+
+    @staticmethod
+    def record_from_line(line: bytes) -> Optional[Overlap]:
+        if line.startswith(b"@"):
+            return None
+        f = line.split(b"\t")
+        return Overlap.from_sam(
+            q_name=f[0].decode(), flag=int(f[1]), t_name=f[2].decode(),
+            t_begin=int(f[3]), cigar=f[5].decode())
+
+
+_SEQUENCE_EXTENSIONS_FASTA = (".fasta", ".fasta.gz", ".fna", ".fna.gz",
+                              ".fa", ".fa.gz")
+_SEQUENCE_EXTENSIONS_FASTQ = (".fastq", ".fastq.gz", ".fq", ".fq.gz")
+
+
+class UnsupportedFormatError(ValueError):
+    pass
+
+
+def create_sequence_parser(path: str):
+    """Extension-sniffing factory (reference: src/polisher.cpp:83-99)."""
+    if path.endswith(_SEQUENCE_EXTENSIONS_FASTA):
+        return FastaParser(path)
+    if path.endswith(_SEQUENCE_EXTENSIONS_FASTQ):
+        return FastqParser(path)
+    raise UnsupportedFormatError(
+        f"file {path} has unsupported format extension (valid extensions: "
+        ".fasta, .fasta.gz, .fna, .fna.gz, .fa, .fa.gz, .fastq, .fastq.gz, "
+        ".fq, .fq.gz)")
+
+
+def create_overlap_parser(path: str):
+    """Extension-sniffing factory (reference: src/polisher.cpp:101-115)."""
+    if path.endswith((".mhap", ".mhap.gz")):
+        return MhapParser(path)
+    if path.endswith((".paf", ".paf.gz")):
+        return PafParser(path)
+    if path.endswith((".sam", ".sam.gz")):
+        return SamParser(path)
+    raise UnsupportedFormatError(
+        f"file {path} has unsupported format extension (valid extensions: "
+        ".mhap, .mhap.gz, .paf, .paf.gz, .sam, .sam.gz)")

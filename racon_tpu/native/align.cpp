@@ -1,0 +1,201 @@
+// Banded global Levenshtein alignment with traceback -> CIGAR, plus a
+// score-only edit distance.  This is the CPU fallback / accuracy-oracle
+// aligner re-providing what racon gets from edlib
+// (reference: vendor/edlib, call site src/overlap.cpp:205-224): global
+// (NW) alignment of an overlap's query span vs target span, emitting a
+// standard CIGAR where 'M' covers both matches and mismatches, 'I'
+// consumes query and 'D' consumes target.
+//
+// Algorithm: Ukkonen banded DP with band doubling.  The band covers
+// diagonals d = j - i in [dmin - k, dmax + k] around the corner-to-corner
+// diagonal; if the computed distance exceeds k the band may have clipped
+// the optimal path, so k doubles and the DP reruns (exact once dist <= k
+// or the band spans the full matrix).  Directions are stored 2 bits/cell
+// over the band only, so memory is O((|q|+|t|) * k / 4) bytes.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int32_t kInf = INT32_MAX / 4;
+
+enum Dir : uint8_t { DIAG = 0, DEL = 1, INS = 2, NONE = 3 };
+// DIAG: from (i-1, j-1)  -> 'M'
+// DEL : from (i,   j-1)  -> 'D' (consumes target)
+// INS : from (i-1, j  )  -> 'I' (consumes query)
+
+struct BandedResult {
+    int32_t distance = -1;
+    bool within_band = false;
+};
+
+// One banded pass.  dirs (if non-null) receives 2-bit packed directions,
+// rows of width `band_w` cells starting at diagonal `dmin`.
+BandedResult banded_pass(const char* q, int32_t qn, const char* t,
+                         int32_t tn, int32_t k, std::vector<uint8_t>* dirs,
+                         int32_t* out_dmin, int32_t* out_band_w) {
+    const int32_t d_lo = std::min(0, tn - qn) - k;
+    const int32_t d_hi = std::max(0, tn - qn) + k;
+    const int32_t band_w = d_hi - d_lo + 1;
+    *out_dmin = d_lo;
+    *out_band_w = band_w;
+
+    std::vector<int32_t> prev(band_w, kInf), cur(band_w, kInf);
+    if (dirs) {
+        dirs->assign(static_cast<size_t>(qn + 1) *
+                         ((band_w + 3) / 4), 0xFF);
+    }
+    auto set_dir = [&](int32_t i, int32_t b, Dir d) {
+        if (!dirs) return;
+        size_t idx = static_cast<size_t>(i) * ((band_w + 3) / 4) + b / 4;
+        int shift = (b % 4) * 2;
+        (*dirs)[idx] = ((*dirs)[idx] & ~(uint8_t(3) << shift)) |
+                       (uint8_t(d) << shift);
+    };
+
+    // row 0: (0, j), j = d - 0
+    for (int32_t b = 0; b < band_w; ++b) {
+        int32_t j = d_lo + b;
+        if (j < 0 || j > tn) continue;
+        prev[b] = j;
+        set_dir(0, b, j == 0 ? NONE : DEL);
+    }
+
+    for (int32_t i = 1; i <= qn; ++i) {
+        std::fill(cur.begin(), cur.end(), kInf);
+        for (int32_t b = 0; b < band_w; ++b) {
+            int32_t j = i + d_lo + b;
+            if (j < 0 || j > tn) continue;
+            int32_t best = kInf;
+            Dir dir = NONE;
+            if (j > 0) {
+                // (i-1, j-1) is the same band index b in row i-1
+                int32_t v = prev[b];
+                if (v < kInf) {
+                    int32_t c = v + (q[i - 1] == t[j - 1] ? 0 : 1);
+                    if (c < best) { best = c; dir = DIAG; }
+                }
+            }
+            if (b + 1 < band_w) {  // (i-1, j) is band index b+1 in row i-1
+                int32_t v = prev[b + 1];
+                if (v < kInf && v + 1 < best) { best = v + 1; dir = INS; }
+            }
+            if (b > 0) {           // (i, j-1) is band index b-1, same row
+                int32_t v = cur[b - 1];
+                if (v < kInf && v + 1 < best) { best = v + 1; dir = DEL; }
+            }
+            cur[b] = best;
+            if (dir != NONE) set_dir(i, b, dir);
+        }
+        std::swap(prev, cur);
+    }
+
+    int32_t end_b = tn - qn - d_lo;
+    BandedResult r;
+    if (end_b >= 0 && end_b < band_w && prev[end_b] < kInf) {
+        r.distance = prev[end_b];
+        r.within_band = r.distance <= k ||
+                        (d_hi - d_lo >= qn + tn);  // band covers everything
+    }
+    return r;
+}
+
+std::string traceback_cigar(const char* q, int32_t qn, const char* t,
+                            int32_t tn, const std::vector<uint8_t>& dirs,
+                            int32_t dmin, int32_t band_w) {
+    auto get_dir = [&](int32_t i, int32_t j) -> Dir {
+        int32_t b = j - i - dmin;
+        size_t idx = static_cast<size_t>(i) * ((band_w + 3) / 4) + b / 4;
+        int shift = (b % 4) * 2;
+        return Dir((dirs[idx] >> shift) & 3);
+    };
+    std::string ops;  // reversed op chars
+    ops.reserve(qn + tn);
+    int32_t i = qn, j = tn;
+    while (i > 0 || j > 0) {
+        Dir d = get_dir(i, j);
+        switch (d) {
+            case DIAG: ops.push_back('M'); --i; --j; break;
+            case INS:  ops.push_back('I'); --i; break;
+            case DEL:  ops.push_back('D'); --j; break;
+            default:   return std::string();  // corrupt band; caller retries
+        }
+    }
+    // run-length encode reversed ops into a CIGAR
+    std::string cigar;
+    cigar.reserve(ops.size() / 4 + 8);
+    for (size_t p = ops.size(); p > 0;) {
+        char op = ops[p - 1];
+        size_t run = 0;
+        while (p > 0 && ops[p - 1] == op) { --p; ++run; }
+        cigar += std::to_string(run);
+        cigar.push_back(op);
+    }
+    return cigar;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Score-only global edit distance (test oracle; the reference's tests use
+// edlib's default config the same way, test/racon_test.cpp:16-25).
+int32_t rt_edit_distance(const char* q, int32_t qn, const char* t,
+                         int32_t tn) {
+    // two-row full DP; O(qn*tn) time, O(tn) space
+    std::vector<int32_t> prev(tn + 1), cur(tn + 1);
+    for (int32_t j = 0; j <= tn; ++j) prev[j] = j;
+    for (int32_t i = 1; i <= qn; ++i) {
+        cur[0] = i;
+        const char qc = q[i - 1];
+        for (int32_t j = 1; j <= tn; ++j) {
+            int32_t best = prev[j - 1] + (qc == t[j - 1] ? 0 : 1);
+            best = std::min(best, prev[j] + 1);
+            best = std::min(best, cur[j - 1] + 1);
+            cur[j] = best;
+        }
+        std::swap(prev, cur);
+    }
+    return prev[tn];
+}
+
+// Global alignment with CIGAR.  Returns the CIGAR length written (excl.
+// NUL), or -1 if cigar_cap is too small, or -2 on internal failure.
+int64_t rt_align(const char* q, int32_t qn, const char* t, int32_t tn,
+                 char* cigar_out, int64_t cigar_cap, int32_t* distance_out) {
+    if (qn == 0 || tn == 0) {
+        std::string cigar;
+        if (qn > 0) cigar = std::to_string(qn) + "I";
+        else if (tn > 0) cigar = std::to_string(tn) + "D";
+        if ((int64_t)cigar.size() + 1 > cigar_cap) return -1;
+        std::memcpy(cigar_out, cigar.c_str(), cigar.size() + 1);
+        if (distance_out) *distance_out = qn + tn;
+        return (int64_t)cigar.size();
+    }
+    int32_t k = std::max<int32_t>(64, std::abs(tn - qn) / 8 + 16);
+    const int32_t k_cap = qn + tn;
+    while (true) {
+        std::vector<uint8_t> dirs;
+        int32_t dmin = 0, band_w = 0;
+        BandedResult r = banded_pass(q, qn, t, tn, k, &dirs, &dmin, &band_w);
+        if (r.distance >= 0 && r.within_band) {
+            std::string cigar = traceback_cigar(q, qn, t, tn, dirs, dmin,
+                                                band_w);
+            if (!cigar.empty()) {
+                if ((int64_t)cigar.size() + 1 > cigar_cap) return -1;
+                std::memcpy(cigar_out, cigar.c_str(), cigar.size() + 1);
+                if (distance_out) *distance_out = r.distance;
+                return (int64_t)cigar.size();
+            }
+        }
+        if (k >= k_cap) return -2;
+        k = std::min(k * 2, k_cap);
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,90 @@
+"""Slow pure-Python reference implementations used as test oracles for
+the native and TPU engines.  Not used in the production pipeline."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+def edit_distance(q: bytes, t: bytes) -> int:
+    prev = list(range(len(t) + 1))
+    for i in range(1, len(q) + 1):
+        cur = [i] + [0] * len(t)
+        for j in range(1, len(t) + 1):
+            cur[j] = min(prev[j - 1] + (q[i - 1] != t[j - 1]),
+                         prev[j] + 1, cur[j - 1] + 1)
+        prev = cur
+    return prev[len(t)]
+
+
+def align_path(q: bytes, t: bytes) -> str:
+    """Full-matrix global Levenshtein with traceback -> CIGAR (M/I/D)."""
+    n, m = len(q), len(t)
+    dp = [[0] * (m + 1) for _ in range(n + 1)]
+    for i in range(n + 1):
+        dp[i][0] = i
+    for j in range(m + 1):
+        dp[0][j] = j
+    for i in range(1, n + 1):
+        for j in range(1, m + 1):
+            dp[i][j] = min(dp[i - 1][j - 1] + (q[i - 1] != t[j - 1]),
+                           dp[i - 1][j] + 1, dp[i][j - 1] + 1)
+    ops: List[str] = []
+    i, j = n, m
+    while i > 0 or j > 0:
+        if i > 0 and j > 0 and \
+                dp[i][j] == dp[i - 1][j - 1] + (q[i - 1] != t[j - 1]):
+            ops.append("M")
+            i, j = i - 1, j - 1
+        elif i > 0 and dp[i][j] == dp[i - 1][j] + 1:
+            ops.append("I")
+            i -= 1
+        else:
+            ops.append("D")
+            j -= 1
+    ops.reverse()
+    cigar = ""
+    k = 0
+    while k < len(ops):
+        run = 1
+        while k + run < len(ops) and ops[k + run] == ops[k]:
+            run += 1
+        cigar += f"{run}{ops[k]}"
+        k += run
+    return cigar
+
+
+def cigar_consumes(cigar: str) -> Tuple[int, int]:
+    """(query, target) lengths a CIGAR consumes."""
+    import re
+    qn = tn = 0
+    for num, op in re.findall(r"(\d+)([MIDNSHP=X])", cigar):
+        n = int(num)
+        if op in "M=X":
+            qn += n
+            tn += n
+        elif op == "I":
+            qn += n
+        elif op in "DN":
+            tn += n
+    return qn, tn
+
+
+def cigar_distance(cigar: str, q: bytes, t: bytes) -> int:
+    """Edit cost implied by a CIGAR over the given pair."""
+    import re
+    cost = qp = tp = 0
+    for num, op in re.findall(r"(\d+)([MIDX=])", cigar):
+        n = int(num)
+        if op in "M=X":
+            for k in range(n):
+                cost += q[qp + k] != t[tp + k]
+            qp += n
+            tp += n
+        elif op == "I":
+            qp += n
+            cost += n
+        elif op == "D":
+            tp += n
+            cost += n
+    return cost

@@ -1,0 +1,3 @@
+"""Device-mesh utilities: 1-D sharding over the batch (window/overlap)
+axis via jax.sharding / shard_map, single-host ICI today, multi-host DCN
+by target sharding (the wrapper's --split equivalent)."""

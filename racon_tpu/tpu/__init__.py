@@ -1,0 +1,3 @@
+"""TPU device path: batched JAX/XLA kernels for the two DP workloads
+(overlap alignment, per-window POA consensus) and the mesh-sharded
+TPUPolisher that drives them with CPU fallback."""

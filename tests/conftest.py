@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Forces JAX onto the CPU backend with 8 virtual devices so multi-chip
+sharding paths can be exercised without TPU hardware, mirroring the
+driver's dryrun environment.  Must run before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+# The reference checkout ships the sample dataset used by its golden
+# tests (reference: test/data, test/racon_test.cpp:27-53).  Data files
+# are consumed in place, read-only.
+REFERENCE_DATA = "/root/reference/test/data"
+
+
+def require_reference_data():
+    if not os.path.isdir(REFERENCE_DATA):
+        pytest.skip("reference sample dataset not available")
+
+
+@pytest.fixture(scope="session")
+def reference_data():
+    require_reference_data()
+    return REFERENCE_DATA

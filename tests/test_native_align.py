@@ -1,0 +1,71 @@
+import random
+
+import pytest
+
+from racon_tpu.ops import cpu, pyref
+
+
+def test_build_and_bind():
+    cpu.get_library()
+
+
+@pytest.mark.parametrize("q,t,expected", [
+    (b"ACGT", b"ACGT", 0),
+    (b"ACGT", b"AGGT", 1),
+    (b"ACGT", b"ACG", 1),
+    (b"", b"ACG", 3),
+    (b"AAAA", b"TTTT", 4),
+])
+def test_edit_distance_small(q, t, expected):
+    assert cpu.edit_distance(q, t) == expected
+
+
+def test_edit_distance_random_vs_pyref():
+    rng = random.Random(7)
+    for _ in range(30):
+        n = rng.randrange(0, 60)
+        m = rng.randrange(1, 60)
+        q = bytes(rng.choice(b"ACGT") for _ in range(n))
+        t = bytes(rng.choice(b"ACGT") for _ in range(m))
+        assert cpu.edit_distance(q, t) == pyref.edit_distance(q, t)
+
+
+def test_align_cigar_valid_and_optimal_random():
+    rng = random.Random(11)
+    for _ in range(30):
+        n = rng.randrange(1, 80)
+        m = rng.randrange(1, 80)
+        q = bytes(rng.choice(b"ACGT") for _ in range(n))
+        t = bytes(rng.choice(b"ACGT") for _ in range(m))
+        cigar = cpu.align(q, t)
+        qn, tn = pyref.cigar_consumes(cigar)
+        assert (qn, tn) == (n, m)
+        assert pyref.cigar_distance(cigar, q, t) == pyref.edit_distance(q, t)
+
+
+def test_align_mutated_long_sequence():
+    # band-doubling path: a long sequence with scattered errors
+    rng = random.Random(3)
+    t = bytes(rng.choice(b"ACGT") for _ in range(5000))
+    q = bytearray(t)
+    for _ in range(400):
+        pos = rng.randrange(len(q))
+        op = rng.randrange(3)
+        if op == 0:
+            q[pos] = rng.choice(b"ACGT")
+        elif op == 1 and len(q) > 1:
+            del q[pos]
+        else:
+            q.insert(pos, rng.choice(b"ACGT"))
+    q = bytes(q)
+    cigar = cpu.align(q, t)
+    qn, tn = pyref.cigar_consumes(cigar)
+    assert (qn, tn) == (len(q), len(t))
+    implied = pyref.cigar_distance(cigar, q, t)
+    exact = cpu.edit_distance(q, t)
+    assert implied == exact
+
+
+def test_align_empty_sides():
+    assert cpu.align(b"", b"ACG") == "3D"
+    assert cpu.align(b"ACG", b"") == "3I"

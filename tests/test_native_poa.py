@@ -1,0 +1,119 @@
+import random
+
+from racon_tpu.core.window import Window, WindowType
+from racon_tpu.ops import cpu, pyref
+
+
+def _window_with_layers(backbone, layers, window_type=WindowType.TGS,
+                        qualities=None):
+    w = Window(0, 0, window_type, backbone, b"!" * len(backbone))
+    for i, (seq, begin, end) in enumerate(layers):
+        q = None if qualities is None else qualities[i]
+        w.add_layer(seq, q, begin, end)
+    return w
+
+
+def test_consensus_fewer_than_three_layers_copies_backbone():
+    w = _window_with_layers(b"ACGTACGT", [(b"ACGTACGT", 0, 7)])
+    engine = cpu.PoaEngine()
+    polished = w.generate_consensus(engine, trim=True)
+    assert polished is False
+    assert w.consensus == b"ACGTACGT"
+
+
+def test_consensus_majority_fixes_substitution():
+    # backbone has an error at position 4; three identical reads fix it
+    backbone = b"ACGTTCGTACGTACGT"
+    truth = b"ACGTACGTACGTACGT"
+    layers = [(truth, 0, len(backbone) - 1)] * 3
+    quals = [bytes([33 + 20] * len(truth))] * 3
+    w = _window_with_layers(backbone, layers, qualities=quals)
+    engine = cpu.PoaEngine()
+    assert w.generate_consensus(engine, trim=False)
+    assert w.consensus == truth
+
+
+def test_consensus_fixes_indels():
+    truth = b"ACGTACGTAGGGACGTACGTACGAATTGGCC"
+    backbone = truth[:10] + truth[12:]  # deletion of 2 bases
+    quals = [bytes([33 + 15] * len(truth))] * 4
+    layers = [(truth, 0, len(backbone) - 1)] * 4
+    w = _window_with_layers(backbone, layers, qualities=quals)
+    engine = cpu.PoaEngine()
+    assert w.generate_consensus(engine, trim=False)
+    assert w.consensus == truth
+
+
+def test_consensus_noisy_reads_converge_to_truth():
+    rng = random.Random(42)
+    truth = bytes(rng.choice(b"ACGT") for _ in range(200))
+    # backbone = truth with 8% errors; reads = truth with 10% errors each
+    def mutate(seq, rate):
+        out = bytearray()
+        for c in seq:
+            r = rng.random()
+            if r < rate / 3:
+                continue  # deletion
+            if r < 2 * rate / 3:
+                out.append(rng.choice(b"ACGT"))  # substitution
+            elif r < rate:
+                out.append(c)
+                out.append(rng.choice(b"ACGT"))  # insertion
+            else:
+                out.append(c)
+        return bytes(out)
+
+    backbone = mutate(truth, 0.08)
+    layers = []
+    quals = []
+    for _ in range(12):
+        read = mutate(truth, 0.10)
+        layers.append((read, 0, len(backbone) - 1))
+        quals.append(bytes([33 + 12] * len(read)))
+    w = _window_with_layers(backbone, layers, qualities=quals)
+    engine = cpu.PoaEngine()
+    assert w.generate_consensus(engine, trim=True)
+    d_backbone = pyref.edit_distance(backbone, truth)
+    d_consensus = pyref.edit_distance(w.consensus, truth)
+    # consensus must be much closer to the truth than the draft backbone
+    assert d_consensus < d_backbone / 2
+    assert d_consensus <= 6
+
+
+def test_partial_span_layers_use_subgraph():
+    rng = random.Random(9)
+    truth = bytes(rng.choice(b"ACGT") for _ in range(300))
+    backbone = bytearray(truth)
+    backbone[150] = ord("A") if truth[150] != ord("A") else ord("C")
+    backbone = bytes(backbone)
+    # reads covering only the middle third
+    layers = []
+    quals = []
+    for _ in range(6):
+        frag = truth[100:200]
+        layers.append((frag, 100, 199))
+        quals.append(bytes([33 + 20] * len(frag)))
+    w = _window_with_layers(backbone, layers, qualities=quals)
+    engine = cpu.PoaEngine()
+    assert w.generate_consensus(engine, trim=False)
+    # the middle error must be fixed; flanks untouched
+    assert pyref.edit_distance(w.consensus, truth) == 0
+
+
+def test_tgs_trim_cuts_uncovered_ends():
+    rng = random.Random(1)
+    truth = bytes(rng.choice(b"ACGT") for _ in range(300))
+    backbone = truth
+    layers = []
+    quals = []
+    for _ in range(10):
+        frag = truth[50:250]
+        layers.append((frag, 50, 249))
+        quals.append(bytes([33 + 20] * len(frag)))
+    w = _window_with_layers(backbone, layers, WindowType.TGS,
+                            qualities=quals)
+    engine = cpu.PoaEngine()
+    assert w.generate_consensus(engine, trim=True)
+    # ends with coverage < (n-1)/2 are trimmed away
+    assert len(w.consensus) <= 210
+    assert pyref.edit_distance(w.consensus, truth[50:250]) == 0

@@ -1,0 +1,123 @@
+import numpy as np
+import pytest
+
+from racon_tpu.core.overlap import Overlap
+from racon_tpu.core.sequence import Sequence
+from racon_tpu.ops import cpu
+
+
+def _reference_walk(cigar, strand, q_begin, q_end, q_length, t_begin,
+                    t_end, w):
+    """Direct transliteration of the per-base walk semantics
+    (reference: src/overlap.cpp:226-292) used as oracle for the
+    vectorised implementation."""
+    import re
+    window_ends = []
+    i = 0
+    while i < t_end:
+        if i > t_begin:
+            window_ends.append(i - 1)
+        i += w
+    window_ends.append(t_end - 1)
+
+    points = []
+    wi = 0
+    found = False
+    first = last = (0, 0)
+    q_ptr = (q_length - q_end if strand else q_begin) - 1
+    t_ptr = t_begin - 1
+    for num, op in re.findall(r"(\d+)([MIDNSHP=X])", cigar):
+        n = int(num)
+        if op in "M=X":
+            for _ in range(n):
+                q_ptr += 1
+                t_ptr += 1
+                if not found:
+                    found = True
+                    first = (t_ptr, q_ptr)
+                last = (t_ptr + 1, q_ptr + 1)
+                if t_ptr == window_ends[wi]:
+                    if found:
+                        points.append(first)
+                        points.append(last)
+                    found = False
+                    wi += 1
+        elif op == "I":
+            q_ptr += n
+        elif op in "DN":
+            for _ in range(n):
+                t_ptr += 1
+                if t_ptr == window_ends[wi]:
+                    if found:
+                        points.append(first)
+                        points.append(last)
+                    found = False
+                    wi += 1
+    return points
+
+
+def _make_overlap(cigar, strand, q_begin, q_end, q_length, t_begin, t_end):
+    o = Overlap()
+    o.cigar = cigar
+    o.strand = strand
+    o.q_begin, o.q_end, o.q_length = q_begin, q_end, q_length
+    o.t_begin, o.t_end = t_begin, t_end
+    o.is_transmuted = True
+    return o
+
+
+@pytest.mark.parametrize("cigar,t_begin,w", [
+    ("500M", 0, 100),
+    ("10M5I10M5D480M", 0, 100),
+    ("250M", 37, 100),
+    ("3S100M2I100M7D100M4S", 12, 50),
+    ("100D100M", 0, 64),
+    ("5M200D5M", 3, 64),
+])
+def test_vectorised_walk_matches_reference_walk(cigar, t_begin, w):
+    import re
+    q_consumed = sum(int(n) for n, op in re.findall(r"(\d+)([MIDNSHP=X])",
+                     cigar) if op in "MI=X")
+    t_consumed = sum(int(n) for n, op in re.findall(r"(\d+)([MIDNSHP=X])",
+                     cigar) if op in "MD=XN")
+    q_begin, q_end, q_length = 0, q_consumed, q_consumed + 10
+    t_end = t_begin + t_consumed
+    for strand in (False, True):
+        o = _make_overlap(cigar, strand, q_begin, q_end, q_length, t_begin,
+                          t_end)
+        o.find_breaking_points_from_cigar(w)
+        expected = _reference_walk(cigar, strand, q_begin, q_end, q_length,
+                                   t_begin, t_end, w)
+        got = [tuple(row) for row in o.breaking_points]
+        assert got == expected
+
+
+def test_walk_on_real_alignment():
+    rng = np.random.default_rng(5)
+    t = bytes(rng.choice(list(b"ACGT"), 2000))
+    q = bytearray(t[200:1800])
+    for pos in sorted(rng.integers(0, 1500, 100), reverse=True):
+        q[pos] = ord(rng.choice(list("ACGT")))
+    q = bytes(q)
+    cigar = cpu.align(q, t[200:1800])
+    o = _make_overlap(cigar, False, 0, len(q), len(q), 200, 1800)
+    o.find_breaking_points_from_cigar(500)
+    expected = _reference_walk(cigar, False, 0, len(q), len(q), 200, 1800,
+                               500)
+    assert [tuple(r) for r in o.breaking_points] == expected
+    # windows covered: target span 200..1800 with w=500 -> boundaries at
+    # 499, 999, 1499, 1799
+    assert len(o.breaking_points) // 2 == 4
+
+
+def test_transmute_name_resolution():
+    seqs = [Sequence("ctg", b"ACGT" * 100), Sequence("r1", b"ACGT" * 25)]
+    name_to_id = {"ctgt": 0, "r1q": 1}
+    o = Overlap.from_paf("r1", 100, 0, 90, "+", "ctg", 400, 10, 100)
+    o.transmute(seqs, name_to_id, {})
+    assert o.is_transmuted and o.is_valid
+    assert o.q_id == 1 and o.t_id == 0
+
+    o2 = Overlap.from_paf("unknown", 100, 0, 90, "+", "ctg", 400, 10, 100)
+    o2.transmute(seqs, name_to_id, {})
+    assert not o2.is_valid

@@ -1,0 +1,129 @@
+import gzip
+import os
+
+import pytest
+
+from racon_tpu.io import (create_overlap_parser, create_sequence_parser)
+from racon_tpu.io.parsers import UnsupportedFormatError
+
+
+def test_fasta_parser(tmp_path):
+    p = tmp_path / "x.fasta"
+    p.write_text(">s1 desc\nACGT\nacgt\n>s2\nTTTT\n")
+    parser = create_sequence_parser(str(p))
+    parser.reset()
+    dst = []
+    assert parser.parse(dst, -1) is False
+    assert [s.name for s in dst] == ["s1", "s2"]
+    assert dst[0].data == b"ACGTACGT"  # uppercased, lines joined
+    assert dst[0].quality == b""
+
+
+def test_fastq_parser_and_dummy_quality_drop(tmp_path):
+    p = tmp_path / "x.fastq"
+    p.write_text("@r1\nacg\n+\nIII\n@r2\nTTT\n+\n!!!\n")
+    parser = create_sequence_parser(str(p))
+    parser.reset()
+    dst = []
+    parser.parse(dst, -1)
+    assert dst[0].data == b"ACG"
+    assert dst[0].quality == b"III"
+    # all-'!' qualities carry no information and are dropped
+    # (reference: src/sequence.cpp:34-41)
+    assert dst[1].quality == b""
+
+
+def test_gzip_transparent(tmp_path):
+    p = tmp_path / "x.fasta.gz"
+    with gzip.open(p, "wt") as fh:
+        fh.write(">s\nACGT\n")
+    parser = create_sequence_parser(str(p))
+    parser.reset()
+    dst = []
+    parser.parse(dst, -1)
+    assert dst[0].data == b"ACGT"
+
+
+def test_fasta_chunked_parse(tmp_path):
+    p = tmp_path / "x.fasta"
+    p.write_text("".join(f">s{i}\n{'ACGT' * 10}\n" for i in range(10)))
+    parser = create_sequence_parser(str(p))
+    parser.reset()
+    dst = []
+    rounds = 0
+    while parser.parse(dst, 100):
+        rounds += 1
+        assert rounds < 100
+    assert len(dst) == 10
+    assert rounds >= 1
+
+
+def test_paf_parser(tmp_path):
+    p = tmp_path / "x.paf"
+    p.write_text("q1\t100\t5\t95\t-\tt1\t1000\t10\t900\t80\t90\t60\n")
+    parser = create_overlap_parser(str(p))
+    parser.reset()
+    dst = []
+    parser.parse(dst, -1)
+    o = dst[0]
+    assert o.q_name == "q1" and o.t_name == "t1"
+    assert o.strand is True
+    assert o.q_begin == 5 and o.q_end == 95
+    assert o.t_begin == 10 and o.t_end == 900
+    assert o.length == 890
+    assert abs(o.error - (1 - 90 / 890)) < 1e-9
+
+
+def test_mhap_parser_one_based_ids(tmp_path):
+    p = tmp_path / "x.mhap"
+    p.write_text("1 2 0.1 42 0 5 95 100 1 10 900 1000\n")
+    parser = create_overlap_parser(str(p))
+    parser.reset()
+    dst = []
+    parser.parse(dst, -1)
+    o = dst[0]
+    assert o.q_id == 0 and o.t_id == 1  # ids converted to 0-based
+    assert o.strand is True  # a_rc ^ b_rc
+
+
+def test_sam_parser(tmp_path):
+    p = tmp_path / "x.sam"
+    p.write_text("@HD\tVN:1.6\n"
+                 "r1\t16\tt1\t11\t60\t5S10M2I3D8M4H\t*\t0\t0\tAC\tII\n")
+    parser = create_overlap_parser(str(p))
+    parser.reset()
+    dst = []
+    parser.parse(dst, -1)
+    o = dst[0]
+    assert o.strand is True
+    assert o.t_begin == 10  # 1-based POS converted
+    # q_aln = 10 + 2 + 8 = 20, clips = 9, q_len = 29
+    assert o.q_length == 29
+    # pre-flip begin = 5, end = 25; strand flips to (29-25, 29-5)
+    assert (o.q_begin, o.q_end) == (4, 24)
+    assert o.t_end == 10 + 10 + 3 + 8
+
+
+def test_unsupported_extension():
+    with pytest.raises(UnsupportedFormatError):
+        create_sequence_parser("reads.txt")
+    with pytest.raises(UnsupportedFormatError):
+        create_overlap_parser("ovl.bed")
+
+
+def test_reference_sample_data_parses(reference_data):
+    parser = create_sequence_parser(
+        os.path.join(reference_data, "sample_layout.fasta.gz"))
+    parser.reset()
+    dst = []
+    parser.parse(dst, -1)
+    assert len(dst) == 1
+    assert dst[0].name == "utg000001l"
+    assert len(dst[0].data) > 40000
+
+    oparser = create_overlap_parser(
+        os.path.join(reference_data, "sample_overlaps.paf.gz"))
+    oparser.reset()
+    ovl = []
+    oparser.parse(ovl, -1)
+    assert len(ovl) > 100
