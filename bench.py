@@ -1,0 +1,110 @@
+"""Benchmark harness (driver contract: prints ONE JSON line).
+
+Headline metric: wall-clock seconds for the end-to-end sample polish
+(the reference's own golden workload: test/data FASTQ reads + PAF
+overlaps -> polished contig, reference test/racon_test.cpp:88-108),
+using the best available accelerated path.  ``vs_baseline`` is the
+speedup of that path over this framework's own CPU fallback path
+measured in the same run (>1 = accelerated path is faster), since the
+reference publishes no wall-clock numbers (SURVEY.md §6) and its CUDA
+binary cannot run here.
+
+Extra context (per-stage seconds, device, accuracy vs the sample
+reference) goes to stderr; stdout carries exactly one JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+DATA = "/root/reference/test/data"
+
+COMPLEMENT = bytes.maketrans(b"ACGT", b"TGCA")
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def read_fasta_gz(path):
+    import gzip
+    seqs, name = {}, None
+    with gzip.open(path, "rb") as fh:
+        for line in fh:
+            line = line.rstrip(b"\n")
+            if line.startswith(b">"):
+                name = line[1:].split()[0].decode()
+                seqs[name] = []
+            else:
+                seqs[name].append(line)
+    return {k: b"".join(v).upper() for k, v in seqs.items()}
+
+
+def run_polish(tpu_poa_batches=0, tpu_aligner_batches=0, threads=8):
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+
+    polisher = create_polisher(
+        os.path.join(DATA, "sample_reads.fastq.gz"),
+        os.path.join(DATA, "sample_overlaps.paf.gz"),
+        os.path.join(DATA, "sample_layout.fasta.gz"),
+        PolisherType.kC, 500, 10.0, 0.3, True, 5, -4, -8,
+        num_threads=threads, tpu_poa_batches=tpu_poa_batches,
+        tpu_aligner_batches=tpu_aligner_batches)
+    t0 = time.monotonic()
+    polisher.initialize()
+    polished = polisher.polish(True)
+    wall = time.monotonic() - t0
+    return wall, polished
+
+
+def accuracy(polished):
+    from racon_tpu.ops import cpu
+    ref = read_fasta_gz(os.path.join(DATA, "sample_reference.fasta.gz"))
+    (ref_seq,) = ref.values()
+    rc = polished[0].data.translate(COMPLEMENT)[::-1]
+    return cpu.edit_distance(rc, ref_seq)
+
+
+def main():
+    if not os.path.isdir(DATA):
+        print(json.dumps({"metric": "sample_e2e_polish_wall_s",
+                          "value": -1.0, "unit": "s", "vs_baseline": 0.0,
+                          "error": "sample dataset not available"}))
+        return
+
+    import jax
+    log(f"[bench] jax devices: {jax.devices()}")
+
+    cpu_wall, cpu_out = run_polish()
+    cpu_dist = accuracy(cpu_out)
+    log(f"[bench] CPU path: {cpu_wall:.2f}s, edit distance {cpu_dist} "
+        "(reference CPU golden 1312, test/racon_test.cpp:107)")
+
+    try:
+        accel_wall, accel_out = run_polish(tpu_poa_batches=1,
+                                           tpu_aligner_batches=1)
+        accel_dist = accuracy(accel_out)
+        log(f"[bench] TPU path: {accel_wall:.2f}s, edit distance "
+            f"{accel_dist} (reference CUDA golden 1385, "
+            "test/racon_test.cpp:312)")
+    except Exception as exc:  # TPU path unavailable -> report CPU path
+        log(f"[bench] TPU path unavailable ({type(exc).__name__}: {exc})")
+        accel_wall, accel_dist = cpu_wall, cpu_dist
+
+    print(json.dumps({
+        "metric": "sample_e2e_polish_wall_s",
+        "value": round(accel_wall, 3),
+        "unit": "s",
+        "vs_baseline": round(cpu_wall / accel_wall, 3),
+        "cpu_wall_s": round(cpu_wall, 3),
+        "edit_distance": int(accel_dist),
+        "cpu_edit_distance": int(cpu_dist),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -251,9 +251,10 @@ class Polisher:
 
             # compact nulls from l onward (reference shrinkToFit,
             # src/polisher.cpp:348-349)
-            n_removed = sum(1 for o in overlaps[l:] if o is None)
+            n_removed_before_c = sum(
+                1 for o in overlaps[l:c] if o is None)
             overlaps[l:] = [o for o in overlaps[l:] if o is not None]
-            l = c - n_removed
+            l = c - n_removed_before_c
             if not status:
                 break
         return overlaps  # type: ignore[return-value]
@@ -267,19 +268,27 @@ class Polisher:
             o.find_breaking_points(self.sequences, self.window_length,
                                    aligner=cpu.align)
 
-        futures = [self._pool.submit(work, o) for o in overlaps]
+        self._run_pooled([(work, (o,)) for o in overlaps],
+                         "[racon_tpu::Polisher::initialize] aligning "
+                         "overlaps",
+                         "[racon_tpu::Polisher::initialize] aligned "
+                         "overlaps")
+
+    def _run_pooled(self, tasks, bar_message: str,
+                    done_message: str) -> list:
+        """Fan tasks over the pool with the reference's 20-bin bar."""
+        futures = [self._pool.submit(fn, *args) for fn, args in tasks]
+        results = []
         step = len(futures) // 20
         for i, f in enumerate(futures):
-            f.result()
+            results.append(f.result())
             if step != 0 and (i + 1) % step == 0 and (i + 1) // step < 20:
-                self.logger.bar("[racon_tpu::Polisher::initialize] aligning "
-                                "overlaps")
+                self.logger.bar(bar_message)
         if step != 0:
-            self.logger.bar("[racon_tpu::Polisher::initialize] aligning "
-                            "overlaps")
+            self.logger.bar(bar_message)
         else:
-            self.logger.log("[racon_tpu::Polisher::initialize] aligned "
-                            "overlaps")
+            self.logger.log(done_message)
+        return results
 
     # ------------------------------------------------------------------
     # windowing (reference: src/polisher.cpp:383-456)
@@ -344,23 +353,11 @@ class Polisher:
 
     def generate_consensuses(self) -> List[bool]:
         """Generate consensus for every window; returns polished flags."""
-        futures = [
-            self._pool.submit(w.generate_consensus, self.engine, self.trim)
-            for w in self.windows]
-        results = []
-        step = len(futures) // 20
-        for i, f in enumerate(futures):
-            results.append(f.result())
-            if step != 0 and (i + 1) % step == 0 and (i + 1) // step < 20:
-                self.logger.bar("[racon_tpu::Polisher::polish] generating "
-                                "consensus")
-        if step != 0:
-            self.logger.bar("[racon_tpu::Polisher::polish] generating "
-                            "consensus")
-        else:
-            self.logger.log("[racon_tpu::Polisher::polish] generated "
-                            "consensus")
-        return results
+        return self._run_pooled(
+            [(w.generate_consensus, (self.engine, self.trim))
+             for w in self.windows],
+            "[racon_tpu::Polisher::polish] generating consensus",
+            "[racon_tpu::Polisher::polish] generated consensus")
 
     def polish(self, drop_unpolished_sequences: bool) -> List[Sequence]:
         self.logger.log()

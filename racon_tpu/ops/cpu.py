@@ -34,8 +34,11 @@ def _build_library() -> None:
             os.path.getmtime(_LIB_PATH) >= os.path.getmtime(s)
             for s in sources):
         return
-    subprocess.run(["make", "-C", _NATIVE_DIR, "-j"], check=True,
-                   capture_output=True)
+    proc = subprocess.run(["make", "-C", _NATIVE_DIR, "-j"],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            "[racon_tpu::native] build failed:\n" + proc.stderr)
 
 
 def get_library() -> ctypes.CDLL:

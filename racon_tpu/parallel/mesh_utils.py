@@ -1,0 +1,66 @@
+"""Device-mesh scaling utilities.
+
+The framework's parallelism is data-parallel over the leading batch axis
+of fixed-shape work batches (windows / overlaps) — the TPU-native
+equivalent of racon-gpu's independent per-device batch queues
+(reference: src/cuda/cudapolisher.cpp:170-188,231-243, which use no
+inter-device communication at all).  A 1-D mesh shards the batch axis
+over ICI; there are no collectives in the hot path, and host-side
+result concatenation is the only "all-gather".
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def default_mesh(max_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over all (or the first ``max_devices``) local devices."""
+    devices = jax.devices()
+    if max_devices is not None:
+        devices = devices[:max_devices]
+    return Mesh(np.array(devices), axis_names=("batch",))
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int,
+                    fill) -> np.ndarray:
+    """Pad the leading axis up to a multiple (mesh-divisible batches)."""
+    b = arr.shape[0]
+    rem = (-b) % multiple
+    if rem == 0:
+        return arr
+    pad_block = np.full((rem,) + arr.shape[1:], fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad_block], axis=0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "lq", "lt"))
+def _sharded_align_impl(q, t, ql, tl, *, mesh: Mesh, lq: int, lt: int):
+    from racon_tpu.tpu.aligner import _align_kernel
+
+    spec = P("batch")
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(spec, spec, spec, spec),
+                       out_specs=spec)
+    def shard_fn(q, t, ql, tl):
+        return _align_kernel(q, t, ql, tl, lq, lt)
+
+    return shard_fn(q, t, ql, tl)
+
+
+def sharded_align(mesh: Mesh, q, t, ql, tl, *, lq: int, lt: int):
+    """Batched alignment sharded over the mesh batch axis.
+
+    The batch must be divisible by the mesh size (use
+    ``pad_to_multiple``); each device runs the wavefront kernel on its
+    shard independently.
+    """
+    return _sharded_align_impl(q, t, ql, tl, mesh=mesh, lq=lq, lt=lt)

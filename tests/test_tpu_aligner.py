@@ -1,0 +1,110 @@
+"""TPU batched aligner vs the native CPU oracle.
+
+Kernel-level tests the reference lacks (SURVEY.md §4 implication (c)):
+the device aligner is new code, so its edit distances must equal the
+CPU engine's (unit-cost global alignment is unique in score, not path)
+and its CIGARs must be valid global-alignment paths of that same cost.
+"""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+from racon_tpu.ops import cpu
+from racon_tpu.tpu import aligner
+
+_CIG_RE = re.compile(rb"(\d+)([=XID])")
+
+
+def mutate(seq: bytes, rate: float, rng: random.Random) -> bytes:
+    out = bytearray()
+    bases = b"ACGT"
+    for c in seq:
+        r = rng.random()
+        if r < rate / 3:            # substitution
+            out.append(rng.choice([b for b in bases if b != c]))
+        elif r < 2 * rate / 3:      # deletion
+            continue
+        elif r < rate:              # insertion
+            out.append(c)
+            out.append(rng.choice(bases))
+        else:
+            out.append(c)
+    return bytes(out)
+
+
+def random_seq(n: int, rng: random.Random) -> bytes:
+    return bytes(rng.choice(b"ACGT") for _ in range(n))
+
+
+def check_cigar(cigar: str, q: bytes, t: bytes) -> int:
+    """Validate a =/X/I/D CIGAR against its pair; return its cost."""
+    qi = ti = cost = 0
+    for n_, op in _CIG_RE.findall(cigar.encode()):
+        n = int(n_)
+        if op == b"=":
+            assert q[qi:qi + n] == t[ti:ti + n], "'=' run mismatches"
+            qi += n
+            ti += n
+        elif op == b"X":
+            assert all(q[qi + k] != t[ti + k] for k in range(n))
+            qi += n
+            ti += n
+            cost += n
+        elif op == b"I":
+            qi += n
+            cost += n
+        else:
+            ti += n
+            cost += n
+    assert qi == len(q) and ti == len(t), "CIGAR does not consume inputs"
+    return cost
+
+
+@pytest.mark.parametrize("rate", [0.0, 0.1, 0.3])
+def test_batch_matches_cpu_oracle(rate):
+    rng = random.Random(42 + int(rate * 10))
+    pairs = []
+    for _ in range(8):
+        t = random_seq(rng.randrange(50, 400), rng)
+        q = mutate(t, rate, rng)
+        if not q:
+            q = b"A"
+        pairs.append((q, t))
+
+    cigars = aligner.align_pairs(pairs)
+    for (q, t), cig in zip(pairs, cigars):
+        cost = check_cigar(cig, q, t)
+        assert cost == cpu.edit_distance(q, t)
+
+
+def test_unequal_lengths_and_tiny():
+    pairs = [(b"A", b"ACGTACGT"), (b"ACGTACGT", b"A"),
+             (b"ACGT", b"ACGT"), (b"A", b"T")]
+    cigars = aligner.align_pairs(pairs)
+    expect_cost = [7, 7, 0, 1]
+    for (q, t), cig, ec in zip(pairs, cigars, expect_cost):
+        assert check_cigar(cig, q, t) == ec
+
+
+def test_batch_aligner_rejects_oversized():
+    a = aligner.TPUBatchAligner(100, 100, 2)
+    assert a.add(b"ACGT", b"ACGT")
+    assert not a.add(b"A" * 101, b"ACGT")   # too long -> CPU fallback
+    assert a.add(b"AC", b"AC")
+    assert not a.add(b"AC", b"AC")          # batch full
+    a.align_all()
+    assert len(a.cigars()) == 2
+    assert a.distances is not None and a.distances[0] == 0
+
+
+def test_distances_match_tape():
+    rng = random.Random(7)
+    t = random_seq(300, rng)
+    q = mutate(t, 0.2, rng)
+    a = aligner.TPUBatchAligner(512, 512, 4)
+    a.add(q, t)
+    a.align_all()
+    assert int(a.distances[0]) == cpu.edit_distance(q, t)
