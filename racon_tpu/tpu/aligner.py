@@ -63,17 +63,22 @@ def encode_batch(seqs: Sequence[bytes], length: int,
     return out
 
 
+# 2-bit direction codes stored by the DP (packed 4 cells/byte in HBM);
+# match/mismatch is recomputed from the bases during traceback
+_DIR_DIAG, _DIR_UP, _DIR_LEFT = 0, 1, 2
+
+
 @functools.partial(jax.jit, static_argnums=(4, 5))
 def _align_kernel(q: jax.Array, t: jax.Array, ql: jax.Array,
                   tl: jax.Array, lq: int, lt: int):
     """Batched unit-cost global alignment.
 
     q: [B, lq] uint8, t: [B, lt] uint8, ql/tl: [B] int32 true lengths.
-    Returns op tape [B, lq+lt] uint8 (reversed traceback order) and the
-    edit distances [B] int32.
+    Returns the op tape [B, lq+lt] uint8 (reversed traceback order).
     """
     b = q.shape[0]
     n_diag = lq + lt
+    packed_w = (lt + 4) // 4             # packed row width (cols lt+1)
     cols = jnp.arange(lt + 1, dtype=jnp.int32)
 
     # rq_pad[lt + m] = q[lq - 1 - m], so the slice starting at
@@ -93,9 +98,8 @@ def _align_kernel(q: jax.Array, t: jax.Array, ql: jax.Array,
 
     def step(carry, d):
         prev, prev2 = carry          # diagonals d-1 and d-2
-        i = d - cols                 # row index per column
-        # cell (i, j): up = D[i-1][j] = prev[j]; left = D[i][j-1] =
-        # prev[j-1]; diag = D[i-1][j-1] = prev2[j-1]
+        # cell (i, j), i = d - j: up = D[i-1][j] = prev[j];
+        # left = D[i][j-1] = prev[j-1]; diag = D[i-1][j-1] = prev2[j-1]
         left = jnp.concatenate(
             [jnp.full((b, 1), _BIG, jnp.int32), prev[:, :-1]], axis=1)
         diag = jnp.concatenate(
@@ -110,34 +114,45 @@ def _align_kernel(q: jax.Array, t: jax.Array, ql: jax.Array,
         # j == d -> D[0][d] = d
         cur = jnp.where((cols == 0) | (cols == d), d, cur)
         dirs = jnp.where(
-            cur == c_diag,
-            jnp.where(sub == 0, OP_EQ, OP_X).astype(jnp.uint8),
-            jnp.where(cur == c_up, OP_I, OP_D).astype(jnp.uint8))
-        dirs = jnp.where((cols == 0) | (cols == d),
-                         jnp.uint8(OP_STOP), dirs)
-        return (cur, prev), dirs
+            cur == c_diag, jnp.uint8(_DIR_DIAG),
+            jnp.where(cur == c_up, jnp.uint8(_DIR_UP),
+                      jnp.uint8(_DIR_LEFT)))
+        # pack 4 cells/byte (boundary cells are reconstructed from i/j
+        # during traceback, so their stored code is irrelevant)
+        pad = jnp.zeros((b, packed_w * 4 - (lt + 1)), jnp.uint8)
+        dp = jnp.concatenate([dirs, pad], axis=1)
+        packed = (dp[:, 0::4] | (dp[:, 1::4] << 2) |
+                  (dp[:, 2::4] << 4) | (dp[:, 3::4] << 6))
+        return (cur, prev), packed
 
     (_, _), dir_rows = lax.scan(
         step, (init_prev, init_prev2),
         jnp.arange(1, n_diag + 1, dtype=jnp.int32))
-    # dir_rows: [n_diag, B, lt+1] for diagonals 1..n_diag
+    # dir_rows: [n_diag, B, packed_w] for diagonals 1..n_diag
+
+    lanes = jnp.arange(b)
+    q_pad1 = jnp.concatenate(
+        [jnp.full((b, 1), _QPAD, jnp.uint8), q], axis=1)   # q[i-1] at i
 
     # device traceback: walk from (ql, tl) to (0, 0)
     def tb_step(carry, _):
         i, j = carry
         done = (i == 0) & (j == 0)
-        d = i + j
-        code = dir_rows[d - 1, jnp.arange(b), j]
-        # boundary walks when the stored code is STOP but we are not done
-        code = jnp.where(code == OP_STOP,
-                         jnp.where(i > 0, OP_I, OP_D).astype(jnp.uint8),
-                         code)
-        code = jnp.where(done, jnp.uint8(OP_STOP), code)
-        di = jnp.where((code == OP_EQ) | (code == OP_X) | (code == OP_I),
-                       1, 0)
-        dj = jnp.where((code == OP_EQ) | (code == OP_X) | (code == OP_D),
-                       1, 0)
-        return (i - di, j - dj), code
+        byte = dir_rows[i + j - 1, lanes, j >> 2]
+        code = (byte >> ((j & 3) * 2)) & 3
+        # boundary rows/columns force the only legal move
+        code = jnp.where(i == 0, jnp.uint8(_DIR_LEFT), code)
+        code = jnp.where(j == 0, jnp.uint8(_DIR_UP), code)
+        qc = q_pad1[lanes, i]
+        tc = t_pad[lanes, j]
+        op = jnp.where(
+            code == _DIR_DIAG,
+            jnp.where(qc == tc, OP_EQ, OP_X),
+            jnp.where(code == _DIR_UP, OP_I, OP_D)).astype(jnp.uint8)
+        op = jnp.where(done, jnp.uint8(OP_STOP), op)
+        di = jnp.where((op == OP_EQ) | (op == OP_X) | (op == OP_I), 1, 0)
+        dj = jnp.where((op == OP_EQ) | (op == OP_X) | (op == OP_D), 1, 0)
+        return (i - di, j - dj), op
 
     (_, _), ops = lax.scan(tb_step, (ql, tl), None, length=n_diag)
     return jnp.transpose(ops)  # [B, n_diag] reversed op tape

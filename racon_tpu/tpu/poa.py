@@ -1,0 +1,408 @@
+"""Batched POA consensus on TPU (cudapoa-equivalent).
+
+Re-creates, TPU-first, what the reference gets from ClaraGenomicsAnalysis
+cudapoa (reference: src/cuda/cudabatch.cpp:52-265): batched partial-order
+alignment consensus over windows.  The CUDA design keeps whole POA
+graphs resident on the GPU and runs one thread block per window; that
+shape does not map to XLA's static-shape compilation model, so the TPU
+design splits the work differently:
+
+* **lockstep layers**: all windows of a batch advance one layer per
+  round; round ``d`` runs ONE ``jit``-compiled batched DP aligning every
+  window's d-th layer against its current graph — the device sees only
+  fixed-shape arrays ``[B, V, ...]``;
+* **graphs live on the host** in C++ (racon_tpu/native/poa_batch.cpp,
+  reusing the CPU engine's PoaGraph): each round exports per-window
+  subgraphs (topo-ordered bases, capped predecessor lists, sink flags)
+  and applies the device-produced alignment paths (spoa add_alignment
+  semantics);
+* the DP scan runs over graph ranks; the in-row gap chain is closed
+  with an associative max-plus scan, so each row step is pure vector
+  work across ``B x (L+1)`` lanes;
+* **traceback runs on device** (one gather per step) and only compact
+  paths ``[B, V+L, 2]`` travel device->host.
+
+Windows that overflow the caps (graph nodes > vcap, in-degree > pcap)
+are failed over to the CPU engine, exactly the reference's rejection
+contract (cudabatch.cpp:124-127 -> cudapolisher.cpp:357-386); over-long
+layers are skipped and only reduce coverage (cudabatch.cpp:136-155).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from racon_tpu.ops import cpu as cpu_ops
+
+_BIG = np.int32(1 << 28)
+
+# traceback tape sentinels (host side)
+PATH_NONE = -1      # no node / no seq position in this step
+PATH_DONE = -3      # walk finished
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7, 8, 9, 10, 11, 12))
+def _poa_kernel(bases, preds, nrows, sinks, seq, slen,
+                v: int, l: int, p: int, k: int,
+                match: int, mismatch: int, gap: int):
+    """Batched global NW of sequences against DAGs in topo-rank order.
+
+    bases: [B, V] uint8 node bases (rank order)
+    preds: [B, V, P] int16 predecessor DP-row indices (0 = virtual
+           start row, -1 = pad); in-edges reach back at most ``k`` rows
+           (enforced by rt_poab_export; violators fall back to CPU)
+    nrows: [B] int32 valid rank count
+    sinks: [B, V] uint8 sink flags
+    seq:   [B, L] uint8 layer bases, slen: [B] int32
+
+    The DP carries only a ring buffer of the last ``k`` score rows (the
+    full [B, V, L] matrix never exists), so the per-step state stays
+    VMEM-sized; sink scores are folded on the fly.  Returns
+    (node_tape, seq_tape): [B, V+L] int32 each, the reversed alignment
+    path per lane; node entries are 0-based ranks or PATH_NONE, seq
+    entries are positions or PATH_NONE, PATH_DONE after the walk
+    reaches the origin.
+    """
+    b = bases.shape[0]
+    cols = jnp.arange(l + 1, dtype=jnp.int32)
+    lanes = jnp.arange(b)
+    neg = jnp.float32(-_BIG)
+    colsf = cols.astype(jnp.float32)
+
+    # virtual start row H[0][j] = j*gap (always addressable as pred 0);
+    # scores are exact in f32 (|score| <= |scores|*(V+L) << 2^24) so the
+    # pred-row pick below can ride the MXU as a one-hot matmul
+    vrow = (colsf * gap)[None, :] + jnp.zeros((b, 1), jnp.float32)
+
+    zero_b = jnp.zeros_like(nrows)          # batch-varying seed
+    ring_init = jnp.full((b, k, l + 1), neg, jnp.float32) \
+        + zero_b[:, None, None]
+    best_init = (jnp.full((b,), neg, jnp.float32) + zero_b,
+                 jnp.zeros((b,), jnp.int32) + zero_b)
+    karange = jnp.arange(k, dtype=jnp.int32)
+
+    def step(carry, r):
+        ring, best_score, best_row = carry
+        pidx = preds[:, r - 1, :].astype(jnp.int32)        # [B, P]
+        # one-hot matmul replaces a per-lane row gather: MXU-friendly
+        slot = (pidx - 1) & (k - 1)
+        onehot = ((slot[:, :, None] == karange[None, None, :]) &
+                  (pidx > 0)[:, :, None]).astype(jnp.float32)
+        gathered = jnp.einsum("bpk,bkl->bpl", onehot, ring,
+                              preferred_element_type=jnp.float32)
+        hp = jnp.where((pidx > 0)[:, :, None], gathered,
+                       jnp.where((pidx == 0)[:, :, None],
+                                 vrow[:, None, :], neg))
+        base_r = bases[:, r - 1]
+        sub = jnp.where(seq == base_r[:, None], match,
+                        mismatch).astype(jnp.float32)       # [B, L]
+        diag_c = hp[:, :, :-1] + sub[:, None, :]            # [B,P,L]
+        vert_c = hp + gap                                   # [B,P,L+1]
+        diag_full = jnp.concatenate(
+            [jnp.full((b, p, 1), neg, jnp.float32), diag_c], axis=2)
+        t_best = jnp.maximum(jnp.max(diag_full, axis=1),
+                             jnp.max(vert_c, axis=1))       # [B, L+1]
+        # close the in-row gap chain: H[r][j] = max_{k<=j} T[k]+(j-k)g
+        shifted = t_best - colsf * gap
+        hr = lax.associative_scan(jnp.maximum, shifted,
+                                  axis=1) + colsf * gap
+        # direction codes with preference diag(p) < vert(p) < horiz,
+        # recomputed against the final row value (always achievable)
+        horiz = jnp.concatenate(
+            [jnp.full((b, 1), neg, jnp.float32), hr[:, :-1] + gap],
+            axis=1)
+        cand = jnp.concatenate(
+            [diag_full, vert_c, horiz[:, None, :]], axis=1)  # [B,2P+1,L+1]
+        dirs = jnp.argmax(cand == hr[:, None, :],
+                          axis=1).astype(jnp.uint8)
+        ring = lax.dynamic_update_slice(
+            ring, hr[:, None, :], (0, (r - 1) & (k - 1), 0))
+        # fold sink-row end scores (earliest rank wins ties via strict >)
+        is_sink = (sinks[:, r - 1] > 0) & (r <= nrows)
+        s_r = hr[lanes, slen]
+        better = is_sink & (s_r > best_score)
+        best_score = jnp.where(better, s_r, best_score)
+        best_row = jnp.where(better, r, best_row)
+        return (ring, best_score, best_row), dirs
+
+    (_, _, best_row), dir_rows = lax.scan(
+        step, (ring_init,) + best_init,
+        jnp.arange(1, v + 1, dtype=jnp.int32))
+    # dir_rows: [V, B, L+1] for ranks 1..V
+
+    def tb_step(carry, _):
+        r, j = carry
+        done = (r == 0) & (j == 0)
+        code = dir_rows[r - 1, lanes, j].astype(jnp.int32)
+        is_diag = (code < p) & (r > 0)
+        is_vert = (code >= p) & (code < 2 * p) & (r > 0)
+        # r == 0 (virtual row) or horiz code: consume a seq char
+        slot = jnp.where(is_diag, code, code - p)
+        slot = jnp.clip(slot, 0, p - 1)
+        pred_r = preds[lanes, jnp.maximum(r - 1, 0), slot].astype(
+            jnp.int32)
+        node = jnp.where(is_diag | is_vert, r - 1, PATH_NONE)
+        spos = jnp.where(is_vert, PATH_NONE, j - 1)
+        node = jnp.where(done, PATH_DONE, node)
+        spos = jnp.where(done, PATH_DONE, spos)
+        nr = jnp.where(is_diag | is_vert, pred_r, r)
+        nj = jnp.where(is_vert, j, jnp.maximum(j - 1, 0))
+        nr = jnp.where(done, r, nr)
+        nj = jnp.where(done, j, nj)
+        return (nr, nj), (node, spos)
+
+    (_, _), (node_tape, seq_tape) = lax.scan(
+        tb_step, (best_row.astype(jnp.int32), slen), None, length=v + l)
+    return jnp.transpose(node_tape), jnp.transpose(seq_tape)
+
+
+class _NativeBatch:
+    """ctypes wrapper over the poa_batch.cpp lockstep API."""
+
+    _bound = False
+
+    @classmethod
+    def _bind(cls):
+        lib = cpu_ops.get_library()
+        if not cls._bound:
+            i8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.rt_poab_create.restype = ctypes.c_void_p
+            lib.rt_poab_create.argtypes = [ctypes.c_int32]
+            lib.rt_poab_destroy.argtypes = [ctypes.c_void_p]
+            lib.rt_poab_seed.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p,
+                ctypes.c_int32, ctypes.c_char_p, ctypes.c_uint8]
+            lib.rt_poab_export.restype = ctypes.c_int32
+            lib.rt_poab_export.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.uint8),
+                np.ctypeslib.ndpointer(np.int16),
+                np.ctypeslib.ndpointer(np.uint8),
+                np.ctypeslib.ndpointer(np.int32)]
+            lib.rt_poab_apply.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.int32),
+                np.ctypeslib.ndpointer(np.int32),
+                ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32,
+                ctypes.c_char_p, ctypes.c_uint8, ctypes.c_int32]
+            lib.rt_poab_num_nodes.restype = ctypes.c_int32
+            lib.rt_poab_num_nodes.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int32]
+            lib.rt_poab_consensus.restype = ctypes.c_int64
+            lib.rt_poab_consensus.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32)]
+            cls._bound = True
+        return lib
+
+    def __init__(self, n_windows: int):
+        self.lib = self._bind()
+        self.handle = ctypes.c_void_p(
+            self.lib.rt_poab_create(n_windows))
+
+    def close(self):
+        if self.handle:
+            self.lib.rt_poab_destroy(self.handle)
+            self.handle = None
+
+    def __del__(self):
+        self.close()
+
+
+class TPUPoaBatchEngine:
+    """Lockstep batched POA over a megabatch of windows.
+
+    Caps (vcap/pcap/lcap/max_depth) mirror the CUDA batch limits
+    (max nodes per graph, max sequences per POA = 200,
+    src/cuda/cudapolisher.cpp:229).
+    """
+
+    def __init__(self, match: int, mismatch: int, gap: int,
+                 vcap: int = 2048, pcap: int = 8, lcap: int = 1024,
+                 kcap: int = 64, max_depth: int = 200,
+                 sharded: bool = False):
+        self.match, self.mismatch, self.gap = match, mismatch, gap
+        self.vcap, self.pcap, self.lcap = vcap, pcap, lcap
+        self.kcap = kcap
+        self.max_depth = max_depth
+        self.sharded = sharded
+        self.n_skipped_layers = 0
+
+    def consensus_batch(self, windows, trim: bool, pool=None) \
+            -> List[Tuple[Optional[bytes], bool]]:
+        """Polish a batch of Window objects on device.
+
+        Returns one (consensus, polished) pair per window; consensus is
+        None when the window overflowed the device caps and must be
+        re-polished on the CPU (reference: cudapolisher.cpp:357-386).
+        """
+        n = len(windows)
+        nb = _NativeBatch(n)
+        try:
+            return self._run(nb, windows, trim, pool)
+        finally:
+            nb.close()
+
+    # -- helpers -------------------------------------------------------
+
+    def _run(self, nb, windows, trim, pool):
+        lib, handle = nb.lib, nb.handle
+        n = len(windows)
+
+        def order_layers(w):
+            idx = sorted(range(1, len(w.sequences)),
+                         key=lambda i: w.positions[i][0])
+            kept = [i for i in idx
+                    if len(w.sequences[i]) <= self.lcap][:self.max_depth]
+            self.n_skipped_layers += len(idx) - len(kept)
+            return kept
+
+        layer_lists = [order_layers(w) for w in windows]
+
+        def seed(i):
+            w = windows[i]
+            backbone = w.sequences[0]
+            qual = w.qualities[0]
+            lib.rt_poab_seed(handle, i, backbone, len(backbone),
+                             qual if qual else b"\x00" * len(backbone),
+                             1 if qual else 0)
+
+        _map(pool, seed, range(n))
+
+        failed = [False] * n
+        max_rounds = max((len(ll) for ll in layer_lists), default=0)
+
+        v, l, p = self.vcap, self.lcap, self.pcap
+        bases = np.zeros((n, v), dtype=np.uint8)
+        preds = np.full((n, v, p), -1, dtype=np.int16)
+        sinks = np.zeros((n, v), dtype=np.uint8)
+        rank2node = np.zeros((n, v), dtype=np.int32)
+        nrows = np.zeros(n, dtype=np.int32)
+        seq_arr = np.zeros((n, l), dtype=np.uint8)
+        slen = np.zeros(n, dtype=np.int32)
+
+        for d in range(max_rounds):
+            active = [i for i in range(n)
+                      if not failed[i] and d < len(layer_lists[i])]
+            if not active:
+                break
+            nrows[:] = 0
+            slen[:] = 0
+
+            def export(i):
+                w = windows[i]
+                li = layer_lists[i][d]
+                begin, end = w.positions[li]
+                blen = len(w.sequences[0])
+                offset = int(0.01 * blen)
+                full = begin < offset and end > blen - offset
+                rows = lib.rt_poab_export(
+                    handle, i, begin, end, 1 if full else 0, v, p,
+                    self.kcap, bases[i], preds[i].reshape(-1),
+                    sinks[i], rank2node[i])
+                if rows < 0:
+                    failed[i] = True
+                    return
+                nrows[i] = rows
+                s = w.sequences[li]
+                seq_arr[i, :len(s)] = np.frombuffer(s, dtype=np.uint8)
+                slen[i] = len(s)
+
+            _map(pool, export, active)
+            active = [i for i in active if not failed[i]]
+            if not active:
+                continue
+
+            node_tape, seq_tape = self._dispatch(
+                bases, preds, nrows, sinks, seq_arr, slen)
+
+            def apply(i):
+                w = windows[i]
+                li = layer_lists[i][d]
+                nt, st = node_tape[i], seq_tape[i]
+                k = int(np.argmax(nt == PATH_DONE)) \
+                    if (nt == PATH_DONE).any() else nt.shape[0]
+                # reversed tape -> forward path; translate ranks -> ids
+                pn = nt[:k][::-1].astype(np.int32)
+                ps = st[:k][::-1].astype(np.int32)
+                mask = pn >= 0
+                pn = np.where(mask, rank2node[i][np.clip(pn, 0, None)],
+                              PATH_NONE)
+                pn = np.ascontiguousarray(pn)
+                ps = np.ascontiguousarray(ps)
+                s = w.sequences[li]
+                q = w.qualities[li]
+                lib.rt_poab_apply(
+                    handle, i, pn, ps, len(pn), s, len(s),
+                    q if q else b"\x00" * len(s), 1 if q else 0,
+                    int(w.positions[li][0]))
+
+            _map(pool, apply, active)
+
+        # consensus extraction
+        results: List[Tuple[Optional[bytes], bool]] = []
+        out_cap = 4 * self.lcap + 4096
+        for i in range(n):
+            if failed[i]:
+                results.append((None, False))
+                continue
+            n_added = 1 + len(layer_lists[i])
+            if n_added < 3:
+                # <3 sequences -> backbone verbatim, unpolished
+                # (reference: cudabatch.cpp:214-222, window.cpp:68-71)
+                results.append((windows[i].sequences[0], False))
+                continue
+            out = ctypes.create_string_buffer(out_cap)
+            status = ctypes.c_int32(0)
+            length = lib.rt_poab_consensus(
+                handle, i, windows[i].type.value, 1 if trim else 0,
+                out, out_cap, ctypes.byref(status))
+            if length < 0:
+                results.append((None, False))
+                continue
+            if status.value == 2:
+                windows[i].warn_chimeric()
+            results.append((out.raw[:length], True))
+        return results
+
+    @staticmethod
+    def _pow2(n: int, lo: int) -> int:
+        b = lo
+        while b < n:
+            b <<= 1
+        return b
+
+    def _dispatch(self, bases, preds, nrows, sinks, seq_arr, slen):
+        # bucket this round's static dims to the active maxima so scan
+        # length tracks real graph sizes, not the worst-case caps
+        v_b = min(self._pow2(int(nrows.max()), 128), self.vcap)
+        l_b = min(self._pow2(int(slen.max()), 128), self.lcap)
+        args = (jnp.asarray(bases[:, :v_b]),
+                jnp.asarray(preds[:, :v_b, :]),
+                jnp.asarray(nrows),
+                jnp.asarray(sinks[:, :v_b]),
+                jnp.asarray(seq_arr[:, :l_b]), jnp.asarray(slen))
+        node_tape, seq_tape = _poa_kernel(
+            *args, v_b, l_b, self.pcap, self.kcap,
+            self.match, self.mismatch, self.gap)
+        return np.asarray(node_tape), np.asarray(seq_tape)
+
+
+def _map(pool, fn, items):
+    if pool is None:
+        for it in items:
+            fn(it)
+    else:
+        list(pool.map(fn, items))

@@ -1,0 +1,233 @@
+"""TPUPolisher: device-offloaded stages behind the Polisher seam.
+
+Mirrors CUDAPolisher's structure (reference: src/cuda/cudapolisher.cpp):
+the same two virtual-method overrides on the same base class —
+``find_overlap_breaking_points`` (aligner stage, cudapolisher.cpp:72-217)
+and ``generate_consensuses`` (POA stage, cudapolisher.cpp:219-421) —
+each gated independently by its batches argument, each falling back to
+the CPU path for any work item the device path rejects
+(cudapolisher.cpp:212-216, 357-386).
+
+TPU-first differences from the CUDA design: instead of per-device batch
+queues driven by host threads, work is packed host-side into
+fixed-shape, power-of-two-bucketed batches and dispatched through one
+jit-compiled kernel per bucket shape, sharded over a 1-D device mesh on
+the batch axis (racon_tpu/parallel/mesh_utils.py).  JAX's async dispatch
+plays the role of CUDA streams.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from racon_tpu.core.overlap import Overlap
+from racon_tpu.core.polisher import Polisher, PolisherType
+from racon_tpu.core.window import WindowType
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+class TPUPolisher(Polisher):
+    # absolute per-alignment dimension cap; larger pairs go to the CPU
+    # aligner (the reference's exceeded_max_length contract,
+    # src/cuda/cudaaligner.cpp:64-72)
+    MAX_ALIGN_DIM = 16384
+    # HBM budget for one batch's packed direction tape (2 bits/cell)
+    ALIGN_MEM_BUDGET = 2 << 30
+    MAX_ALIGNMENTS_PER_BATCH = 1024
+
+    def __init__(self, sparser, oparser, tparser, type_: PolisherType,
+                 window_length: int, quality_threshold: float,
+                 error_threshold: float, trim: bool, match: int,
+                 mismatch: int, gap: int, num_threads: int,
+                 tpu_poa_batches: int, tpu_banded_alignment: bool,
+                 tpu_aligner_batches: int):
+        super().__init__(sparser, oparser, tparser, type_, window_length,
+                         quality_threshold, error_threshold, trim, match,
+                         mismatch, gap, num_threads)
+        self.tpu_poa_batches = tpu_poa_batches
+        self.tpu_banded_alignment = tpu_banded_alignment
+        self.tpu_aligner_batches = tpu_aligner_batches
+        self.max_align_dim = _env_int("RACON_TPU_MAX_ALIGN_DIM",
+                                      self.MAX_ALIGN_DIM)
+        self.align_mem_budget = _env_int("RACON_TPU_ALIGN_BUDGET",
+                                         self.ALIGN_MEM_BUDGET)
+        self._mesh = None
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from racon_tpu.parallel import mesh_utils
+            self._mesh = mesh_utils.default_mesh()
+        return self._mesh
+
+    # ------------------------------------------------------------------
+    # POA consensus stage (reference: src/cuda/cudapolisher.cpp:219-421)
+    # ------------------------------------------------------------------
+
+    # depth cap per window, mirroring MAX_DEPTH_PER_WINDOW
+    # (src/cuda/cudapolisher.cpp:229)
+    MAX_DEPTH_PER_WINDOW = 200
+    POA_BATCH_SIZE = 128
+
+    def _poa_caps(self):
+        """Device cap selection: power-of-two graph/layer caps scaled
+        from the window length (the CUDA analog sizes batches from free
+        GPU memory, src/cuda/cudapolisher.cpp:231-242)."""
+        w = self.window_length
+        vcap = self._bucket_dim(4 * w)
+        lcap = self._bucket_dim(2 * w)
+        return vcap, lcap
+
+    def generate_consensuses(self) -> List[bool]:
+        if self.tpu_poa_batches <= 0:
+            return super().generate_consensuses()
+
+        from racon_tpu.tpu.poa import TPUPoaBatchEngine
+
+        vcap, lcap = self._poa_caps()
+        batch_size = _env_int("RACON_TPU_POA_BATCH", self.POA_BATCH_SIZE)
+        engine = TPUPoaBatchEngine(
+            self.match, self.mismatch, self.gap, vcap=vcap, pcap=8,
+            lcap=lcap, max_depth=self.MAX_DEPTH_PER_WINDOW)
+
+        # trivial windows (<3 sequences) keep the backbone and count as
+        # unpolished (window.cpp:68-71); the rest go to the device in
+        # depth-sorted megabatches so lockstep rounds drain uniformly
+        flags = [False] * len(self.windows)
+        eligible = [i for i, w in enumerate(self.windows)
+                    if len(w.sequences) >= 3]
+        for i, w in enumerate(self.windows):
+            if len(w.sequences) < 3:
+                w.consensus = w.sequences[0]
+        eligible.sort(key=lambda i: -len(self.windows[i].sequences))
+
+        failed: List[int] = []
+        n_done = 0
+        for k in range(0, len(eligible), batch_size):
+            idxs = eligible[k:k + batch_size]
+            batch = [self.windows[i] for i in idxs]
+            results = engine.consensus_batch(batch, self.trim,
+                                             pool=self._pool)
+            for i, (cons, ok) in zip(idxs, results):
+                if cons is None:
+                    failed.append(i)
+                else:
+                    self.windows[i].consensus = cons
+                    flags[i] = ok
+            n_done += len(idxs)
+            self.logger.bar("[racon_tpu::TPUPolisher::polish] generating"
+                            " consensus (device)")
+
+        # CPU re-polish of device-rejected windows
+        # (reference: src/cuda/cudapolisher.cpp:357-386)
+        if failed:
+            self.logger.log(
+                f"[racon_tpu::TPUPolisher::polish] {len(failed)} "
+                "window(s) fell back to the CPU engine")
+            def repolish(i):
+                return self.windows[i].generate_consensus(self.engine,
+                                                          self.trim)
+            cpu_flags = list(self._pool.map(repolish, failed))
+            for i, f in zip(failed, cpu_flags):
+                flags[i] = f
+        if engine.n_skipped_layers:
+            self.logger.log(
+                f"[racon_tpu::TPUPolisher::polish] skipped "
+                f"{engine.n_skipped_layers} over-long layer(s)")
+        return flags
+
+    # ------------------------------------------------------------------
+    # aligner stage (reference: src/cuda/cudapolisher.cpp:72-217)
+    # ------------------------------------------------------------------
+
+    def find_overlap_breaking_points(self, overlaps: List[Overlap]) -> None:
+        if self.tpu_aligner_batches > 0:
+            self._device_align_overlaps(overlaps)
+        # CPU path computes breaking points for everything, running the
+        # CPU aligner only for overlaps still lacking a CIGAR
+        # (cudapolisher.cpp:212-216)
+        super().find_overlap_breaking_points(overlaps)
+
+    @staticmethod
+    def _bucket_dim(n: int) -> int:
+        """Round up to the power-of-two bucket (min 512) to bound the
+        number of compiled kernel variants."""
+        b = 512
+        while b < n:
+            b <<= 1
+        return b
+
+    def _device_align_overlaps(self, overlaps: List[Overlap]) -> None:
+        pending = []  # (bucket_lq, bucket_lt, overlap)
+        for o in overlaps:
+            if o.cigar or o.breaking_points is not None:
+                continue
+            lq = o.q_end - o.q_begin
+            lt = o.t_end - o.t_begin
+            if max(lq, lt) > self.max_align_dim or min(lq, lt) == 0:
+                continue  # CPU fallback
+            pending.append((self._bucket_dim(lq), self._bucket_dim(lt), o))
+        if not pending:
+            return
+
+        # group by bucket shape, then chunk by the memory budget:
+        # packed direction tape is (lq+lt) * ceil((lt+1)/4) bytes/lane
+        pending.sort(key=lambda x: (x[0], x[1]))
+        n_dev = len(self.mesh.devices)
+        n_done = 0
+        i = 0
+        while i < len(pending):
+            blq, blt, _ = pending[i]
+            j = i
+            while j < len(pending) and pending[j][:2] == (blq, blt):
+                j += 1
+            bytes_per_lane = (blq + blt) * ((blt + 4) // 4)
+            max_b = max(n_dev, int(self.align_mem_budget // bytes_per_lane))
+            max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
+            for k in range(i, j, max_b):
+                chunk = [o for _, _, o in pending[k:min(k + max_b, j)]]
+                self._align_chunk(chunk, blq, blt, n_dev)
+                n_done += len(chunk)
+                self.logger.log(
+                    f"[racon_tpu::TPUPolisher::align] device-aligned "
+                    f"{n_done}/{len(pending)} overlaps "
+                    f"(bucket {blq}x{blt})")
+            i = j
+
+    def _align_chunk(self, chunk: List[Overlap], blq: int, blt: int,
+                     n_dev: int) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from racon_tpu.parallel import mesh_utils
+        from racon_tpu.tpu import aligner
+
+        queries = [o.query_span(self.sequences) for o in chunk]
+        targets = [o.target_span(self.sequences) for o in chunk]
+        q = aligner.encode_batch(queries, blq, aligner._QPAD)
+        t = aligner.encode_batch(targets, blt, aligner._TPAD)
+        ql = np.array([len(s) for s in queries], dtype=np.int32)
+        tl = np.array([len(s) for s in targets], dtype=np.int32)
+
+        # pad the batch to a mesh-divisible size
+        q = mesh_utils.pad_to_multiple(q, n_dev, aligner._QPAD)
+        t = mesh_utils.pad_to_multiple(t, n_dev, aligner._TPAD)
+        ql = mesh_utils.pad_to_multiple(ql, n_dev, 1)
+        tl = mesh_utils.pad_to_multiple(tl, n_dev, 1)
+
+        if n_dev > 1:
+            sharding = NamedSharding(self.mesh, P("batch"))
+            args = [jax.device_put(a, sharding) for a in (q, t, ql, tl)]
+            ops = mesh_utils.sharded_align(self.mesh, *args, lq=blq,
+                                           lt=blt)
+        else:
+            ops = aligner._align_kernel(q, t, ql, tl, blq, blt)
+        ops = np.asarray(ops)
+        for idx, o in enumerate(chunk):
+            o.cigar = aligner.ops_to_cigar(ops[idx])

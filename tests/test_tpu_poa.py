@@ -1,0 +1,100 @@
+"""TPU lockstep batched POA vs the native CPU engine.
+
+Kernel-level tests the reference lacks (SURVEY.md §4 implication (c)).
+The device DP may pick a different cost-equal alignment path than the
+CPU traceback, so consensus equality is asserted within a small edit
+band; recovery of a known truth sequence is asserted exactly.
+"""
+
+import random
+
+import pytest
+
+from racon_tpu.core.window import Window, WindowType
+from racon_tpu.ops import cpu
+from racon_tpu.tpu.poa import TPUPoaBatchEngine
+from tests.test_tpu_aligner import mutate, random_seq
+
+
+def make_window(truth: bytes, depth: int, rate: float,
+                rng: random.Random, wtype=WindowType.TGS,
+                backbone: bytes = None) -> Window:
+    bb = backbone if backbone is not None else mutate(truth, rate, rng)
+    w = Window(0, 0, wtype, bb, b"!" * len(bb))
+    for _ in range(depth):
+        layer = mutate(truth, rate, rng)
+        qual = bytes(rng.randrange(50, 80) for _ in range(len(layer)))
+        w.add_layer(layer, qual, 0, len(bb) - 1)
+    return w
+
+def cpu_consensus(window, match=5, mismatch=-4, gap=-8, trim=True):
+    eng = cpu.PoaEngine(match, mismatch, gap)
+    return eng.consensus(window, trim)
+
+
+@pytest.mark.parametrize("depth,rate", [(6, 0.05), (12, 0.15)])
+def test_device_poa_recovers_truth(depth, rate):
+    rng = random.Random(11)
+    truth = random_seq(180, rng)
+    windows = [make_window(truth, depth, rate, rng) for _ in range(3)]
+
+    eng = TPUPoaBatchEngine(5, -4, -8, vcap=512, pcap=8, lcap=256)
+    results = eng.consensus_batch(windows, trim=True)
+    for w, (cons, ok) in zip(windows, results):
+        assert ok and cons is not None
+        d_truth = cpu.edit_distance(cons, truth)
+        d_cpu = cpu.edit_distance(cons, cpu_consensus(w))
+        # device consensus must be near the CPU engine's and close to
+        # the truth (backbone starts `rate` away from it)
+        assert d_truth <= max(2, int(0.02 * len(truth))), \
+            f"truth distance {d_truth}"
+        assert d_cpu <= max(2, int(0.02 * len(truth))), \
+            f"cpu-engine distance {d_cpu}"
+
+
+def test_partial_span_layers():
+    rng = random.Random(5)
+    truth = random_seq(300, rng)
+    bb = mutate(truth, 0.08, rng)
+    w = Window(0, 0, WindowType.TGS, bb, b"!" * len(bb))
+    # layers covering only sub-spans of the backbone
+    for lo, hi in [(0, 149), (100, 249), (150, 299), (0, 299),
+                   (50, 199), (200, 299)]:
+        frag = mutate(truth[lo:hi + 1], 0.08, rng)
+        w.add_layer(frag, None, min(lo, len(bb) - 1),
+                    min(hi, len(bb) - 1))
+    eng = TPUPoaBatchEngine(5, -4, -8, vcap=1024, pcap=8, lcap=512)
+    (cons, ok), = eng.consensus_batch([w], trim=False)
+    assert ok
+    d_cpu = cpu.edit_distance(cons, cpu_consensus(w, trim=False))
+    assert d_cpu <= max(3, int(0.03 * len(truth))), f"cpu dist {d_cpu}"
+
+
+def test_thin_window_returns_backbone():
+    rng = random.Random(3)
+    truth = random_seq(100, rng)
+    w = make_window(truth, 1, 0.1, rng)   # backbone + 1 layer < 3
+    eng = TPUPoaBatchEngine(5, -4, -8, vcap=256, pcap=8, lcap=128)
+    (cons, ok), = eng.consensus_batch([w], trim=True)
+    assert not ok and cons == w.sequences[0]
+
+
+def test_vcap_overflow_falls_back():
+    rng = random.Random(9)
+    truth = random_seq(200, rng)
+    w = make_window(truth, 8, 0.3, rng)
+    # vcap below the backbone length: export must fail immediately
+    eng = TPUPoaBatchEngine(5, -4, -8, vcap=128, pcap=8, lcap=256)
+    (cons, ok), = eng.consensus_batch([w], trim=True)
+    assert cons is None and not ok
+
+
+def test_overlong_layers_skipped_not_fatal():
+    rng = random.Random(13)
+    truth = random_seq(150, rng)
+    w = make_window(truth, 5, 0.05, rng)
+    w.add_layer(random_seq(400, rng), None, 0, 149)  # > lcap
+    eng = TPUPoaBatchEngine(5, -4, -8, vcap=512, pcap=8, lcap=200)
+    (cons, ok), = eng.consensus_batch([w], trim=True)
+    assert ok and cons is not None
+    assert eng.n_skipped_layers == 1

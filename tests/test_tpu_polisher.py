@@ -1,0 +1,42 @@
+"""TPUPolisher aligner stage: device/CPU mixed path on the sample data.
+
+Mirrors the reference's CUDA e2e strategy (test/racon_test.cpp:292-341):
+same pipeline with device batches enabled, its own accuracy latitude,
+and the CPU-fallback contract for work the device path rejects.
+"""
+
+import os
+
+import pytest
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from tests.test_e2e import polished_distance, run_polisher
+
+
+@pytest.mark.slow
+def test_aligner_stage_device_with_cpu_fallback(reference_data,
+                                                monkeypatch):
+    # small cap so the CPU-backend device kernel stays fast: overlaps
+    # with span <= 2048 go to the device, the rest exercise the CPU
+    # fallback (reference contract: cudapolisher.cpp:212-216)
+    monkeypatch.setenv("RACON_TPU_MAX_ALIGN_DIM", "2048")
+    polished = run_polisher(reference_data, "sample_reads.fastq.gz",
+                            "sample_overlaps.paf.gz",
+                            "sample_layout.fasta.gz",
+                            tpu_aligner_batches=1)
+    assert len(polished) == 1
+    d = polished_distance(reference_data, polished[0].data)
+    # reference CPU golden 1312, CUDA 1385 (racon_test.cpp:107,312)
+    assert d < 1450, f"device-aligned consensus regressed: {d}"
+
+
+def test_tpu_polisher_construction(reference_data):
+    p = create_polisher(
+        os.path.join(reference_data, "sample_reads.fastq.gz"),
+        os.path.join(reference_data, "sample_overlaps.paf.gz"),
+        os.path.join(reference_data, "sample_layout.fasta.gz"),
+        PolisherType.kC, 500, 10.0, 0.3, True, 5, -4, -8, 4,
+        tpu_poa_batches=1, tpu_banded_alignment=False,
+        tpu_aligner_batches=1)
+    from racon_tpu.tpu.polisher import TPUPolisher
+    assert isinstance(p, TPUPolisher)
