@@ -97,7 +97,10 @@ int32_t rt_poab_export(void* h, int32_t w, int32_t begin, int32_t end,
 
     std::vector<int32_t> order = g.topo_order(ws.subset);
     const int32_t rows = static_cast<int32_t>(order.size());
-    if (rows > vcap) return -1;
+    // preds stores rank+1 as int16: reject rows beyond its range even
+    // when the caller's vcap is larger (user-settable -w can push
+    // vcap past 32767), so the cast below can never overflow
+    if (rows > vcap || rows > INT16_MAX - 1) return -1;
 
     std::vector<int32_t> rank(n, -1);
     for (int32_t r = 0; r < rows; ++r) rank[order[r]] = r;

@@ -17,8 +17,12 @@ from typing import Optional
 import numpy as np
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 
 
 def default_mesh(max_devices: Optional[int] = None) -> Mesh:
@@ -64,3 +68,39 @@ def sharded_align(mesh: Mesh, q, t, ql, tl, *, lq: int, lt: int):
     shard independently.
     """
     return _sharded_align_impl(q, t, ql, tl, mesh=mesh, lq=lq, lt=lt)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "v", "l", "p", "k", "match", "mismatch",
+                     "gap"))
+def _sharded_poa_impl(bases, preds, nrows, sinks, seq, slen, *,
+                      mesh: Mesh, v: int, l: int, p: int, k: int,
+                      match: int, mismatch: int, gap: int):
+    from racon_tpu.tpu.poa import _poa_kernel
+
+    spec = P("batch")
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(spec,) * 6,
+                       out_specs=(spec, spec))
+    def shard_fn(bases, preds, nrows, sinks, seq, slen):
+        return _poa_kernel(bases, preds, nrows, sinks, seq, slen,
+                           v, l, p, k, match, mismatch, gap)
+
+    return shard_fn(bases, preds, nrows, sinks, seq, slen)
+
+
+def sharded_poa(mesh: Mesh, bases, preds, nrows, sinks, seq, slen, *,
+                v: int, l: int, p: int, k: int, match: int,
+                mismatch: int, gap: int):
+    """One batched POA layer-round sharded over the mesh batch axis.
+
+    TPU-native analog of racon-gpu's per-device POA batch queues
+    (reference: src/cuda/cudapolisher.cpp:231-243): windows are
+    embarrassingly parallel, so the round's fixed-shape arrays shard on
+    the leading axis with no collectives in the hot path.
+    """
+    return _sharded_poa_impl(bases, preds, nrows, sinks, seq, slen,
+                             mesh=mesh, v=v, l=l, p=p, k=k, match=match,
+                             mismatch=mismatch, gap=gap)

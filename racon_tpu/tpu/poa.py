@@ -232,12 +232,15 @@ class TPUPoaBatchEngine:
     def __init__(self, match: int, mismatch: int, gap: int,
                  vcap: int = 2048, pcap: int = 8, lcap: int = 1024,
                  kcap: int = 64, max_depth: int = 200,
-                 sharded: bool = False):
+                 mesh=None):
         self.match, self.mismatch, self.gap = match, mismatch, gap
         self.vcap, self.pcap, self.lcap = vcap, pcap, lcap
         self.kcap = kcap
         self.max_depth = max_depth
-        self.sharded = sharded
+        # mesh: shard each round's batch axis over the devices
+        # (reference analog: per-device POA batch queues,
+        # src/cuda/cudapolisher.cpp:231-243)
+        self.mesh = mesh
         self.n_skipped_layers = 0
 
     def consensus_batch(self, windows, trim: bool, pool=None) \
@@ -358,8 +361,10 @@ class TPUPoaBatchEngine:
             if failed[i]:
                 results.append((None, False))
                 continue
-            n_added = 1 + len(layer_lists[i])
-            if n_added < 3:
+            # gate on the RAW window sequence count, like the reference
+            # (cudabatch.cpp:214-222): layers skipped for length/depth
+            # only reduce coverage, they do not demote the window
+            if len(windows[i].sequences) < 3:
                 # <3 sequences -> backbone verbatim, unpolished
                 # (reference: cudabatch.cpp:214-222, window.cpp:68-71)
                 results.append((windows[i].sequences[0], False))
@@ -389,14 +394,23 @@ class TPUPoaBatchEngine:
         # length tracks real graph sizes, not the worst-case caps
         v_b = min(self._pow2(int(nrows.max()), 128), self.vcap)
         l_b = min(self._pow2(int(slen.max()), 128), self.lcap)
-        args = (jnp.asarray(bases[:, :v_b]),
-                jnp.asarray(preds[:, :v_b, :]),
-                jnp.asarray(nrows),
-                jnp.asarray(sinks[:, :v_b]),
-                jnp.asarray(seq_arr[:, :l_b]), jnp.asarray(slen))
+        args = (bases[:, :v_b], preds[:, :v_b, :], nrows,
+                sinks[:, :v_b], seq_arr[:, :l_b], slen)
+        n_dev = len(self.mesh.devices) if self.mesh is not None else 1
+        if n_dev > 1:
+            from racon_tpu.parallel import mesh_utils
+            args = [mesh_utils.pad_to_multiple(np.ascontiguousarray(a),
+                                               n_dev, 0)
+                    for a in args]
+            node_tape, seq_tape = mesh_utils.sharded_poa(
+                self.mesh, *args, v=v_b, l=l_b, p=self.pcap,
+                k=self.kcap, match=self.match, mismatch=self.mismatch,
+                gap=self.gap)
+            b = bases.shape[0]
+            return np.asarray(node_tape)[:b], np.asarray(seq_tape)[:b]
         node_tape, seq_tape = _poa_kernel(
-            *args, v_b, l_b, self.pcap, self.kcap,
-            self.match, self.mismatch, self.gap)
+            *(jnp.asarray(a) for a in args), v_b, l_b, self.pcap,
+            self.kcap, self.match, self.mismatch, self.gap)
         return np.asarray(node_tape), np.asarray(seq_tape)
 
 

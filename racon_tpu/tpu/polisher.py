@@ -92,9 +92,11 @@ class TPUPolisher(Polisher):
 
         vcap, lcap = self._poa_caps()
         batch_size = _env_int("RACON_TPU_POA_BATCH", self.POA_BATCH_SIZE)
+        n_dev = len(self.mesh.devices)
         engine = TPUPoaBatchEngine(
             self.match, self.mismatch, self.gap, vcap=vcap, pcap=8,
-            lcap=lcap, max_depth=self.MAX_DEPTH_PER_WINDOW)
+            lcap=lcap, max_depth=self.MAX_DEPTH_PER_WINDOW,
+            mesh=self.mesh if n_dev > 1 else None)
 
         # trivial windows (<3 sequences) keep the backbone and count as
         # unpolished (window.cpp:68-71); the rest go to the device in
