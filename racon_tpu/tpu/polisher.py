@@ -58,6 +58,8 @@ class TPUPolisher(Polisher):
         self.align_mem_budget = _env_int("RACON_TPU_ALIGN_BUDGET",
                                          self.ALIGN_MEM_BUDGET)
         self._mesh = None
+        from racon_tpu.utils.xla_cache import enable_compilation_cache
+        enable_compilation_cache()
 
     @property
     def mesh(self):
@@ -94,8 +96,8 @@ class TPUPolisher(Polisher):
         batch_size = _env_int("RACON_TPU_POA_BATCH", self.POA_BATCH_SIZE)
         n_dev = len(self.mesh.devices)
         engine = TPUPoaBatchEngine(
-            self.match, self.mismatch, self.gap, vcap=vcap, pcap=8,
-            lcap=lcap, max_depth=self.MAX_DEPTH_PER_WINDOW,
+            self.match, self.mismatch, self.gap, vcap=vcap, pcap=16,
+            lcap=lcap, kcap=128, max_depth=self.MAX_DEPTH_PER_WINDOW,
             mesh=self.mesh if n_dev > 1 else None)
 
         # trivial windows (<3 sequences) keep the backbone and count as
@@ -129,9 +131,12 @@ class TPUPolisher(Polisher):
         # CPU re-polish of device-rejected windows
         # (reference: src/cuda/cudapolisher.cpp:357-386)
         if failed:
+            rc = engine.reject_counts
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::polish] {len(failed)} "
-                "window(s) fell back to the CPU engine")
+                "window(s) fell back to the CPU engine "
+                f"(vcap {rc.get(-1, 0)}, pcap {rc.get(-2, 0)}, "
+                f"kcap {rc.get(-3, 0)})")
             def repolish(i):
                 return self.windows[i].generate_consensus(self.engine,
                                                           self.trim)
