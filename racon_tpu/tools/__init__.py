@@ -1,0 +1,6 @@
+"""Dataset tooling around the polisher (reference: scripts/ + rampler).
+
+``rampler``    — subsample/split tool (reference: vendor/rampler)
+``wrapper``    — racon_wrapper equivalent (reference: scripts/racon_wrapper.py)
+``preprocess`` — Illumina pair renamer (reference: scripts/racon_preprocess.py)
+"""

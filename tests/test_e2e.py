@@ -78,6 +78,106 @@ def test_consensus_without_qualities(reference_data):
     assert d < 1750, f"consensus accuracy regressed: {d}"
 
 
+@pytest.mark.slow
+def test_consensus_with_qualities_and_alignments(reference_data):
+    # reference golden: 1317 (test/racon_test.cpp:151); CUDA: 1541
+    polished = run_polisher(reference_data, "sample_reads.fastq.gz",
+                            "sample_overlaps.sam.gz",
+                            "sample_layout.fasta.gz")
+    assert len(polished) == 1
+    d = polished_distance(reference_data, polished[0].data)
+    assert d < 1450, f"consensus accuracy regressed: {d}"
+
+
+@pytest.mark.slow
+def test_consensus_without_qualities_and_with_alignments(reference_data):
+    # reference golden: 1770 (test/racon_test.cpp:173); CUDA: 1661
+    polished = run_polisher(reference_data, "sample_reads.fasta.gz",
+                            "sample_overlaps.sam.gz",
+                            "sample_layout.fasta.gz")
+    assert len(polished) == 1
+    d = polished_distance(reference_data, polished[0].data)
+    assert d < 1950, f"consensus accuracy regressed: {d}"
+
+
+@pytest.mark.slow
+def test_consensus_with_qualities_larger_window(reference_data):
+    # reference golden: 1289 (test/racon_test.cpp:195); CUDA: 4168
+    polished = run_polisher(reference_data, "sample_reads.fastq.gz",
+                            "sample_overlaps.paf.gz",
+                            "sample_layout.fasta.gz", window=1000)
+    assert len(polished) == 1
+    d = polished_distance(reference_data, polished[0].data)
+    assert d < 1450, f"consensus accuracy regressed: {d}"
+
+
+@pytest.mark.slow
+def test_consensus_with_qualities_edit_distance_scores(reference_data):
+    # reference golden: 1321 (test/racon_test.cpp:217); CUDA: 1361
+    polished = run_polisher(reference_data, "sample_reads.fastq.gz",
+                            "sample_overlaps.paf.gz",
+                            "sample_layout.fasta.gz",
+                            match=1, mismatch=-1, gap=-1)
+    assert len(polished) == 1
+    d = polished_distance(reference_data, polished[0].data)
+    assert d < 1500, f"consensus accuracy regressed: {d}"
+
+
+@pytest.mark.slow
+def test_fragment_correction_with_qualities(reference_data):
+    # reference golden: 39 seqs / 389,394 bp (test/racon_test.cpp:229-235)
+    # kC mode on ava overlaps keeps only the longest overlap per query
+    # (polisher.cpp:293-305) and drops unpolished reads
+    polished = run_polisher(reference_data, "sample_reads.fastq.gz",
+                            "sample_ava_overlaps.paf.gz",
+                            "sample_reads.fastq.gz",
+                            type_=PolisherType.kC,
+                            match=1, mismatch=-1, gap=-1, drop=True)
+    assert len(polished) == 39
+    total = sum(len(s.data) for s in polished)
+    assert abs(total - 389394) < 4000, f"total length drifted: {total}"
+
+
+@pytest.mark.slow
+def test_fragment_correction_with_qualities_full(reference_data):
+    # reference golden: 236 seqs / 1,658,216 bp (racon_test.cpp:247-253)
+    polished = run_polisher(reference_data, "sample_reads.fastq.gz",
+                            "sample_ava_overlaps.paf.gz",
+                            "sample_reads.fastq.gz",
+                            type_=PolisherType.kF,
+                            match=1, mismatch=-1, gap=-1, drop=False)
+    assert len(polished) == 236
+    total = sum(len(s.data) for s in polished)
+    assert abs(total - 1658216) < 17000, f"total length drifted: {total}"
+
+
+@pytest.mark.slow
+def test_fragment_correction_without_qualities_full(reference_data):
+    # reference golden: 236 seqs / 1,663,982 bp (racon_test.cpp:265-271)
+    polished = run_polisher(reference_data, "sample_reads.fasta.gz",
+                            "sample_ava_overlaps.paf.gz",
+                            "sample_reads.fasta.gz",
+                            type_=PolisherType.kF,
+                            match=1, mismatch=-1, gap=-1, drop=False)
+    assert len(polished) == 236
+    total = sum(len(s.data) for s in polished)
+    assert abs(total - 1663982) < 17000, f"total length drifted: {total}"
+
+
+@pytest.mark.slow
+def test_fragment_correction_with_qualities_full_mhap(reference_data):
+    # reference golden: 236 seqs / 1,658,216 bp, identical to the PAF
+    # run (racon_test.cpp:283-289) — MHAP parses to the same overlaps
+    polished = run_polisher(reference_data, "sample_reads.fastq.gz",
+                            "sample_ava_overlaps.mhap.gz",
+                            "sample_reads.fastq.gz",
+                            type_=PolisherType.kF,
+                            match=1, mismatch=-1, gap=-1, drop=False)
+    assert len(polished) == 236
+    total = sum(len(s.data) for s in polished)
+    assert abs(total - 1658216) < 17000, f"total length drifted: {total}"
+
+
 def test_invalid_polisher_inputs(reference_data):
     from racon_tpu.core.overlap import InvalidInputError
     from racon_tpu.io.parsers import UnsupportedFormatError

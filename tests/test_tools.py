@@ -1,0 +1,118 @@
+"""Tests for the dataset tools (rampler equiv, wrapper, preprocess).
+
+Reference behaviours mirrored: rampler subsample/split output naming
+(scripts/racon_wrapper.py:72-80,96-108), wrapper sequential chunk runs
+(racon_wrapper.py:118-141), preprocess pair renaming
+(scripts/racon_preprocess.py).
+"""
+
+import io
+import os
+import subprocess
+import sys
+
+import pytest
+
+from racon_tpu.io.parsers import create_sequence_parser
+from racon_tpu.tools import preprocess, rampler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_names_and_data(path):
+    parser = create_sequence_parser(path)
+    dst = []
+    parser.parse(dst, -1)
+    parser.close()
+    return [(s.name, s.data) for s in dst]
+
+
+def test_rampler_split_naming_and_content(reference_data, tmp_path):
+    src = os.path.join(reference_data, "sample_reads.fasta.gz")
+    paths = rampler.split(src, 200000, str(tmp_path))
+    assert len(paths) > 1
+    for i, p in enumerate(paths):
+        assert os.path.basename(p) == f"sample_reads_{i}.fasta"
+    # concatenated chunks reproduce the input set, in order
+    merged = [rec for p in paths for rec in load_names_and_data(p)]
+    assert merged == load_names_and_data(src)
+    # every chunk except possibly the last respects the byte bound
+    for p in paths[:-1]:
+        total = sum(len(d) for _, d in load_names_and_data(p))
+        assert total <= 200000
+
+
+def test_rampler_subsample_naming_and_budget(reference_data, tmp_path):
+    src = os.path.join(reference_data, "sample_reads.fastq.gz")
+    out = rampler.subsample(src, 47564, 5, str(tmp_path))
+    assert os.path.basename(out) == "sample_reads_5x.fastq"
+    recs = load_names_and_data(out)
+    total = sum(len(d) for _, d in recs)
+    assert total >= 47564 * 5
+    # subset of the input, input order preserved
+    src_names = [n for n, _ in load_names_and_data(src)]
+    names = [n for n, _ in recs]
+    assert names == [n for n in src_names if n in set(names)]
+    # deterministic run-to-run
+    out2 = rampler.subsample(src, 47564, 5, str(tmp_path))
+    assert load_names_and_data(out2) == recs
+
+
+def test_preprocess_pair_renaming(tmp_path):
+    fq = tmp_path / "pairs.fastq"
+    fq.write_text("@read1 extra\nACGT\n+\nIIII\n"
+                  "@read2\nGGCC\n+\nIIII\n")
+    fq2 = tmp_path / "pairs2.fastq"
+    fq2.write_text("@read1\nTTAA\n+\nIIII\n")
+    out = io.StringIO()
+    seen = set()
+    preprocess.parse_file(str(fq), seen, out)
+    preprocess.parse_file(str(fq2), seen, out)
+    lines = out.getvalue().splitlines()
+    assert lines[0] == "@read11"     # first occurrence -> suffix 1
+    assert lines[4] == "@read21"
+    assert lines[8] == "@read12"     # repeat -> suffix 2
+    assert lines[9] == "TTAA"
+
+
+def test_rampler_fastq_split_roundtrips_no_quality_reads(tmp_path):
+    """Reads whose qualities were dropped on parse (all-'!') must stay
+    valid FASTQ records in split chunks, not silently demote to FASTA
+    inside a .fastq file (which the FASTQ parser would then skip)."""
+    src = tmp_path / "mix.fastq"
+    src.write_bytes(b"@r1\nACGT\n+\n!!!!\n@r2\nGGCC\n+\nIIII\n")
+    paths = rampler.split(str(src), 4, str(tmp_path / "out"))
+    merged = [rec for p in paths for rec in load_names_and_data(p)]
+    assert [n for n, _ in merged] == ["r1", "r2"]
+
+
+def test_wrapper_split_polish_equals_unsplit(tmp_path):
+    """Wrapper-driven multi-chunk split run concatenates to the
+    unsplit output (reference contract: racon_wrapper.py:118-141 runs
+    racon per chunk, outputs are independent per-target polishes)."""
+    targets = tmp_path / "targets.fasta"
+    t1 = b"ACGTTGCAACGTGGCCAATTCCGGACGTACGTTTAACCGGATCGATCGTA"
+    t2 = b"TTGACCAGTAGGCCTTAGGCATCGAATTCGGCCAATGGTTACGCGATCAA"
+    targets.write_bytes(b">t1\n" + t1 + b"\n>t2\n" + t2 + b"\n")
+    reads = tmp_path / "reads.fasta"
+    reads.write_bytes(b">r1\n" + t1 + b"\n>r2\n" + t2 + b"\n")
+    overlaps = tmp_path / "ovl.paf"
+    overlaps.write_bytes(
+        b"r1\t50\t0\t50\t+\tt1\t50\t0\t50\t50\t50\t255\n"
+        b"r2\t50\t0\t50\t+\tt2\t50\t0\t50\t50\t50\t255\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run(args):
+        return subprocess.run(
+            [sys.executable, "-m", "racon_tpu.tools.wrapper"] + args,
+            capture_output=True, env=env, cwd=str(tmp_path), timeout=300)
+
+    base = ["-u", str(reads), str(overlaps), str(targets)]
+    unsplit = run(base)
+    assert unsplit.returncode == 0, unsplit.stderr.decode()
+    split = run(["--split", "50"] + base)
+    assert split.returncode == 0, split.stderr.decode()
+    assert b"total number of splits: 2" in split.stderr
+    assert split.stdout == unsplit.stdout
+    assert unsplit.stdout.count(b">") == 2
