@@ -21,7 +21,8 @@ import sys
 from racon_tpu import __version__
 from racon_tpu.core.overlap import InvalidInputError
 from racon_tpu.core.polisher import PolisherType, create_polisher
-from racon_tpu.io.parsers import UnsupportedFormatError
+from racon_tpu.io.parsers import (MalformedInputError,
+                                  UnsupportedFormatError)
 
 USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequences>
 
@@ -186,8 +187,8 @@ def main(argv=None):
         polisher.initialize()
         polished = polisher.polish(opts["drop_unpolished"])
         polisher.total_log()
-    except (InvalidInputError, UnsupportedFormatError, FileNotFoundError) \
-            as exc:
+    except (InvalidInputError, UnsupportedFormatError,
+            MalformedInputError, FileNotFoundError) as exc:
         print(f"[racon_tpu::] error: {exc}", file=sys.stderr)
         raise SystemExit(1)
 

@@ -152,17 +152,35 @@ class _OverlapLineParser(_LineChunkParser):
         fh = self._ensure_open()
         budget = max_bytes if max_bytes >= 0 else float("inf")
         consumed = 0
+        line_no = getattr(self, "_line_no", 0)
         for raw in fh:
+            line_no += 1
             line = raw.rstrip(b"\r\n")
             if not line:
                 continue
-            record = self.record_from_line(line)
+            try:
+                record = self.record_from_line(line)
+            except (IndexError, ValueError, UnicodeDecodeError) as exc:
+                # diagnosable hard error, like bioparser's
+                # format-violation exits (reference: vendored bioparser
+                # used at src/polisher.cpp:86-125)
+                self._line_no = line_no
+                raise MalformedInputError(
+                    f"{self.path}:{line_no}: malformed "
+                    f"{type(self).__name__.replace('Parser', '')} "
+                    f"record ({exc})") from exc
             if record is not None:
                 dst.append(record)
             consumed += len(raw)
             if consumed >= budget:
+                self._line_no = line_no
                 return True
+        self._line_no = line_no
         return False
+
+    def reset(self) -> None:
+        super().reset()
+        self._line_no = 0
 
 
 class PafParser(_OverlapLineParser):
@@ -215,6 +233,10 @@ _SEQUENCE_EXTENSIONS_FASTQ = (".fastq", ".fastq.gz", ".fq", ".fq.gz")
 
 class UnsupportedFormatError(ValueError):
     pass
+
+
+class MalformedInputError(ValueError):
+    """A record violates its declared format (path:line diagnostics)."""
 
 
 def create_sequence_parser(path: str):

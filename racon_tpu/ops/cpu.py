@@ -21,17 +21,31 @@ import numpy as np
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libracon_native.so")
+
+
+def _lib_path() -> str:
+    """Resolved at call time so RACON_TPU_NATIVE_LIB (e.g. the ASan
+    `make debug` build) works even when set after import."""
+    return os.environ.get(
+        "RACON_TPU_NATIVE_LIB",
+        os.path.join(_NATIVE_DIR, "libracon_native.so"))
 
 _lib = None
 _lib_lock = threading.Lock()
 
 
 def _build_library() -> None:
+    lib_path = _lib_path()
+    if "RACON_TPU_NATIVE_LIB" in os.environ:
+        if not os.path.exists(lib_path):
+            raise RuntimeError(
+                f"[racon_tpu::native] RACON_TPU_NATIVE_LIB points at a "
+                f"missing library: {lib_path}")
+        return
     sources = [os.path.join(_NATIVE_DIR, s)
                for s in ("align.cpp", "poa.cpp")]
-    if os.path.exists(_LIB_PATH) and all(
-            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(s)
+    if os.path.exists(lib_path) and all(
+            os.path.getmtime(lib_path) >= os.path.getmtime(s)
             for s in sources):
         return
     proc = subprocess.run(["make", "-C", _NATIVE_DIR, "-j"],
@@ -49,7 +63,7 @@ def get_library() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         _build_library()
-        lib = ctypes.CDLL(_LIB_PATH)
+        lib = ctypes.CDLL(_lib_path())
         lib.rt_edit_distance.restype = ctypes.c_int32
         lib.rt_edit_distance.argtypes = [
             ctypes.c_char_p, ctypes.c_int32,

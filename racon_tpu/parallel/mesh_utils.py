@@ -45,9 +45,10 @@ def pad_to_multiple(arr: np.ndarray, multiple: int,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("mesh", "lq", "lt"))
-def _sharded_align_impl(q, t, ql, tl, *, mesh: Mesh, lq: int, lt: int):
-    from racon_tpu.tpu.aligner import _align_kernel
+                   static_argnames=("mesh", "lq", "lt", "hw"))
+def _sharded_align_impl(q, t, ql, tl, *, mesh: Mesh, lq: int, lt: int,
+                        hw: int = 0):
+    from racon_tpu.tpu.aligner import _align_kernel, _banded_align_kernel
 
     spec = P("batch")
 
@@ -55,29 +56,33 @@ def _sharded_align_impl(q, t, ql, tl, *, mesh: Mesh, lq: int, lt: int):
                        in_specs=(spec, spec, spec, spec),
                        out_specs=spec)
     def shard_fn(q, t, ql, tl):
+        if hw:
+            return _banded_align_kernel(q, t, ql, tl, lq, lt, hw)
         return _align_kernel(q, t, ql, tl, lq, lt)
 
     return shard_fn(q, t, ql, tl)
 
 
-def sharded_align(mesh: Mesh, q, t, ql, tl, *, lq: int, lt: int):
+def sharded_align(mesh: Mesh, q, t, ql, tl, *, lq: int, lt: int,
+                  hw: int = 0):
     """Batched alignment sharded over the mesh batch axis.
 
     The batch must be divisible by the mesh size (use
-    ``pad_to_multiple``); each device runs the wavefront kernel on its
-    shard independently.
+    ``pad_to_multiple``); each device runs the wavefront kernel
+    (banded when ``hw`` > 0) on its shard independently.
     """
-    return _sharded_align_impl(q, t, ql, tl, mesh=mesh, lq=lq, lt=lt)
+    return _sharded_align_impl(q, t, ql, tl, mesh=mesh, lq=lq, lt=lt,
+                               hw=hw)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "v", "l", "p", "k", "match", "mismatch",
-                     "gap"))
+    static_argnames=("mesh", "v", "l", "p", "k", "wb", "match",
+                     "mismatch", "gap"))
 def _sharded_poa_impl(bases, preds, nrows, sinks, seq, slen, *,
                       mesh: Mesh, v: int, l: int, p: int, k: int,
-                      match: int, mismatch: int, gap: int):
-    from racon_tpu.tpu.poa import _poa_kernel
+                      wb: int, match: int, mismatch: int, gap: int):
+    from racon_tpu.tpu.poa import _poa_kernel, _poa_kernel_banded
 
     spec = P("batch")
 
@@ -85,6 +90,10 @@ def _sharded_poa_impl(bases, preds, nrows, sinks, seq, slen, *,
                        in_specs=(spec,) * 6,
                        out_specs=(spec, spec))
     def shard_fn(bases, preds, nrows, sinks, seq, slen):
+        if wb:
+            return _poa_kernel_banded(bases, preds, nrows, sinks, seq,
+                                      slen, v, l, p, k, wb, match,
+                                      mismatch, gap)
         return _poa_kernel(bases, preds, nrows, sinks, seq, slen,
                            v, l, p, k, match, mismatch, gap)
 
@@ -93,7 +102,7 @@ def _sharded_poa_impl(bases, preds, nrows, sinks, seq, slen, *,
 
 def sharded_poa(mesh: Mesh, bases, preds, nrows, sinks, seq, slen, *,
                 v: int, l: int, p: int, k: int, match: int,
-                mismatch: int, gap: int):
+                mismatch: int, gap: int, wb: int = 0):
     """One batched POA layer-round sharded over the mesh batch axis.
 
     TPU-native analog of racon-gpu's per-device POA batch queues
@@ -102,5 +111,5 @@ def sharded_poa(mesh: Mesh, bases, preds, nrows, sinks, seq, slen, *,
     the leading axis with no collectives in the hot path.
     """
     return _sharded_poa_impl(bases, preds, nrows, sinks, seq, slen,
-                             mesh=mesh, v=v, l=l, p=p, k=k, match=match,
-                             mismatch=mismatch, gap=gap)
+                             mesh=mesh, v=v, l=l, p=p, k=k, wb=wb,
+                             match=match, mismatch=mismatch, gap=gap)

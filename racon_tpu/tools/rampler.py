@@ -26,13 +26,15 @@ import sys
 from typing import List
 
 from racon_tpu.core.sequence import Sequence
-from racon_tpu.io.parsers import create_sequence_parser
+from racon_tpu.io.parsers import (_SEQUENCE_EXTENSIONS_FASTA,
+                                  create_sequence_parser)
 
 
 def _base_and_ext(path: str):
     base = os.path.basename(path).split(".")[0]
-    lowered = path.lower()
-    is_fasta = lowered.endswith((".fasta", ".fasta.gz", ".fa", ".fa.gz"))
+    # same format classification as the parsers (incl. .fna variants),
+    # so output chunks keep the input's record type
+    is_fasta = path.lower().endswith(_SEQUENCE_EXTENSIONS_FASTA)
     return base, (".fasta" if is_fasta else ".fastq")
 
 

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from racon_tpu.ops import cpu as cpu_ops
+from racon_tpu.utils.tuning import poa_band_cols, scan_unroll as _unroll
 
 _BIG = np.int32(1 << 28)
 
@@ -134,7 +135,7 @@ def _poa_kernel(bases, preds, nrows, sinks, seq, slen,
 
     (_, _, best_row), dir_rows = lax.scan(
         step, (ring_init,) + best_init,
-        jnp.arange(1, v + 1, dtype=jnp.int32))
+        jnp.arange(1, v + 1, dtype=jnp.int32), unroll=_unroll(1))
     # dir_rows: [V, B, L+1] for ranks 1..V
 
     def tb_step(carry, _):
@@ -159,7 +160,165 @@ def _poa_kernel(bases, preds, nrows, sinks, seq, slen,
         return (nr, nj), (node, spos)
 
     (_, _), (node_tape, seq_tape) = lax.scan(
-        tb_step, (best_row.astype(jnp.int32), slen), None, length=v + l)
+        tb_step, (best_row.astype(jnp.int32), slen), None, length=v + l,
+        unroll=_unroll(1))
+    return jnp.transpose(node_tape), jnp.transpose(seq_tape)
+
+
+@functools.partial(jax.jit,
+                   static_argnums=(6, 7, 8, 9, 10, 11, 12, 13))
+def _poa_kernel_banded(bases, preds, nrows, sinks, seq, slen,
+                       v: int, l: int, p: int, k: int, wb: int,
+                       match: int, mismatch: int, gap: int):
+    """Banded variant of :func:`_poa_kernel`.
+
+    Same inputs/outputs, but each rank's DP row is restricted to a
+    ``wb``-column band centred on the rank's expected sequence position
+    ``r * slen / nrows`` (layers align near the graph diagonal; indel
+    drift within a 500 bp window is far below wb/2).  The ring buffer,
+    candidate tensors and direction tape all shrink from ``l+1`` to
+    ``wb`` columns, which is what the round cost is bound by (HBM
+    traffic).  Band starts are a deterministic function of (r, slen,
+    nrows), so the traceback recomputes them instead of storing them.
+    The CUDA analog is cudapoa's banded NW (reference:
+    src/cuda/cudabatch.cpp:54-62 banded flag).
+
+    TPU-critical detail: band starts are QUANTIZED to ``wb//4`` so that
+    cross-band realignment (pred rows and the sequence slice) is a
+    select over a handful of statically-shifted slices — per-element
+    ``take_along_axis`` gathers on the lane dimension are ~14x slower
+    than the whole unbanded row DP (measured on v5e).
+    """
+    b = bases.shape[0]
+    q = wb // 4                       # band-start quantum
+    n_shift = 5                       # pred rows can lag <= 4 quanta
+    cols = jnp.arange(wb, dtype=jnp.int32)
+    colsf = cols.astype(jnp.float32)
+    lanes = jnp.arange(b)
+    neg = jnp.float32(-_BIG)
+    nr = jnp.maximum(nrows, 1)
+    # max band start in quanta: CEIL so the top band still reaches
+    # column slen (s_max*q >= slen+1-wb; and s_max*q <= slen since
+    # q <= wb), keeping the alignment endpoint inside the band
+    smax_q = (jnp.maximum(slen + 1 - wb, 0) + q - 1) // q
+
+    def band_start_q(r):
+        """Quantized band start (in units of q) for rank(s) r ([B] or
+        scalar), clamped so rank nrows can reach column slen (the
+        alignment endpoint)."""
+        c = ((r * slen) // nr - (wb // 2)) // q
+        return jnp.clip(c, 0, smax_q)
+
+    # per-lane sequence slices at every quantized start, precomputed
+    # once: seq_sl[m][b, c] = seq[b, m*q + c - 1] (static slices)
+    n_seq_sl = (max(0, l + 1 - wb) + q - 1) // q + 1
+    seq_padl = jnp.concatenate(
+        [jnp.zeros((b, 1), jnp.uint8), seq,
+         jnp.zeros((b, wb), jnp.uint8)], axis=1)
+    seq_sl = jnp.stack([seq_padl[:, m * q: m * q + wb]
+                        for m in range(n_seq_sl)])   # [M, B, wb]
+
+    zero_f = jnp.zeros_like(nrows).astype(jnp.float32)
+    ring_init = jnp.full((b, k, wb), neg, jnp.float32) \
+        + zero_f[:, None, None]
+    best_init = (jnp.full((b,), neg, jnp.float32) + zero_f,
+                 jnp.zeros((b,), jnp.int32) + jnp.zeros_like(nrows))
+
+    def step(carry, r):
+        ring, best_score, best_row = carry
+        sq_r = band_start_q(r)                           # [B] (units q)
+        s_r = sq_r * q
+        pidx = preds[:, r - 1, :].astype(jnp.int32)      # [B, P]
+        slot = (pidx - 1) & (k - 1)
+        g1 = jnp.take_along_axis(ring, slot[:, :, None], axis=1)
+        # realign pred rows (stored from their own band starts) to this
+        # rank's band: hp_ext[c] = H_pred[s_r + c - 1], c in [0, wb].
+        # delta is a whole number of quanta, so the realignment is a
+        # select over n_shift statically-shifted slices of g1.
+        sq_p = jnp.clip(
+            ((pidx * slen[:, None]) // nr[:, None] - (wb // 2)) // q,
+            0, smax_q[:, None])                          # [B, P]
+        dq = sq_r[:, None] - sq_p                        # [B, P] >= 0
+        g1_pad = jnp.concatenate(
+            [jnp.full((b, p, 1), neg, jnp.float32), g1,
+             jnp.full((b, p, n_shift * q), neg, jnp.float32)], axis=2)
+        hp_ext = jnp.full((b, p, wb + 1), neg, jnp.float32)
+        for m in range(n_shift):
+            # slice m: H_pred values at columns s_p + m*q + c - 1
+            hp_ext = jnp.where((dq == m)[:, :, None],
+                               g1_pad[:, :, m * q: m * q + wb + 1],
+                               hp_ext)
+        j_ext = s_r[:, None] + jnp.arange(wb + 1,
+                                          dtype=jnp.int32)[None, :] - 1
+        vv = jnp.where(j_ext >= 0, j_ext.astype(jnp.float32) * gap,
+                       neg)                              # virtual row
+        hp_ext = jnp.where((pidx > 0)[:, :, None], hp_ext,
+                           jnp.where((pidx == 0)[:, :, None],
+                                     vv[:, None, :], neg))
+        base_r = bases[:, r - 1]
+        # sequence chars for this band: select the precomputed slice
+        sb = seq_sl[0]
+        for m in range(1, n_seq_sl):
+            sb = jnp.where((sq_r == m)[:, None], seq_sl[m], sb)
+        j_sub = s_r[:, None] + cols[None, :] - 1         # seq index
+        sub_ok = (j_sub >= 0) & (j_sub < slen[:, None]) \
+            & (sb == base_r[:, None])
+        sub = jnp.where(sub_ok, match, mismatch).astype(jnp.float32)
+        diag_c = hp_ext[:, :, :wb] + sub[:, None, :]     # [B, P, wb]
+        vert_c = hp_ext[:, :, 1:] + gap                  # [B, P, wb]
+        t_best = jnp.maximum(jnp.max(diag_c, axis=1),
+                             jnp.max(vert_c, axis=1))    # [B, wb]
+        shifted = t_best - colsf * gap
+        hr = lax.associative_scan(jnp.maximum, shifted,
+                                  axis=1) + colsf * gap
+        horiz = jnp.concatenate(
+            [jnp.full((b, 1), neg, jnp.float32), hr[:, :-1] + gap],
+            axis=1)
+        cand = jnp.concatenate(
+            [diag_c, vert_c, horiz[:, None, :]], axis=1)  # [B,2P+1,wb]
+        dirs = jnp.argmax(cand == hr[:, None, :],
+                          axis=1).astype(jnp.uint8)
+        ring = lax.dynamic_update_slice(
+            ring, hr[:, None, :], (0, (r - 1) & (k - 1), 0))
+        is_sink = (sinks[:, r - 1] > 0) & (r <= nrows)
+        c_end = slen - s_r
+        s_end = jnp.take_along_axis(
+            hr, jnp.clip(c_end, 0, wb - 1)[:, None], axis=1)[:, 0]
+        better = is_sink & (c_end < wb) & (s_end > best_score)
+        best_score = jnp.where(better, s_end, best_score)
+        best_row = jnp.where(better, r, best_row)
+        return (ring, best_score, best_row), dirs
+
+    (_, _, best_row), dir_rows = lax.scan(
+        step, (ring_init,) + best_init,
+        jnp.arange(1, v + 1, dtype=jnp.int32), unroll=_unroll(1))
+    # dir_rows: [V, B, wb] for ranks 1..V
+
+    def tb_step(carry, _):
+        r, j = carry
+        done = (r == 0) & (j == 0)
+        c = jnp.clip(j - band_start_q(r) * q, 0, wb - 1)
+        code = dir_rows[jnp.maximum(r - 1, 0), lanes, c].astype(
+            jnp.int32)
+        is_diag = (code < p) & (r > 0)
+        is_vert = (code >= p) & (code < 2 * p) & (r > 0)
+        slot = jnp.where(is_diag, code, code - p)
+        slot = jnp.clip(slot, 0, p - 1)
+        pred_r = preds[lanes, jnp.maximum(r - 1, 0), slot].astype(
+            jnp.int32)
+        node = jnp.where(is_diag | is_vert, r - 1, PATH_NONE)
+        spos = jnp.where(is_vert, PATH_NONE, j - 1)
+        node = jnp.where(done, PATH_DONE, node)
+        spos = jnp.where(done, PATH_DONE, spos)
+        nr_ = jnp.where(is_diag | is_vert, pred_r, r)
+        nj = jnp.where(is_vert, j, jnp.maximum(j - 1, 0))
+        nr_ = jnp.where(done, r, nr_)
+        nj = jnp.where(done, j, nj)
+        return (nr_, nj), (node, spos)
+
+    (_, _), (node_tape, seq_tape) = lax.scan(
+        tb_step, (best_row.astype(jnp.int32), slen), None, length=v + l,
+        unroll=_unroll(1))
     return jnp.transpose(node_tape), jnp.transpose(seq_tape)
 
 
@@ -230,11 +389,16 @@ class TPUPoaBatchEngine:
     def __init__(self, match: int, mismatch: int, gap: int,
                  vcap: int = 2048, pcap: int = 16, lcap: int = 1024,
                  kcap: int = 128, max_depth: int = 200,
-                 mesh=None):
+                 band_cols: int = 0, mesh=None):
         self.match, self.mismatch, self.gap = match, mismatch, gap
         self.vcap, self.pcap, self.lcap = vcap, pcap, lcap
         self.kcap = kcap
         self.max_depth = max_depth
+        # band_cols: DP band width (columns) for the banded kernel;
+        # 0 = auto (quarter of the layer bucket, floor 256).  The -b
+        # flag narrows it (cudapoa banded analog, cudabatch.cpp:54-62).
+        self.band_cols = band_cols
+        self.cells = 0
         # mesh: shard each round's batch axis over the devices
         # (reference analog: per-device POA batch queues,
         # src/cuda/cudapolisher.cpp:231-243)
@@ -335,6 +499,11 @@ class TPUPoaBatchEngine:
             if not active:
                 continue
 
+            # NOTE: no active-lane compaction — the rank scan's cost is
+            # per-step overhead x steps, independent of batch width
+            # (measured: compacting tail rounds to 32 lanes saved
+            # nothing and the extra compiled shapes cost ~5s), so idle
+            # lanes in late rounds ride along for free
             node_tape, seq_tape = self._dispatch(
                 bases, preds, nrows, sinks, seq_arr, slen)
 
@@ -361,46 +530,54 @@ class TPUPoaBatchEngine:
 
             _map(pool, apply, active)
 
-        # consensus extraction
-        results: List[Tuple[Optional[bytes], bool]] = []
+        # consensus extraction (pooled; the native call releases the GIL)
+        results: List[Tuple[Optional[bytes], bool]] = [None] * n
         out_cap = 4 * self.lcap + 4096
-        for i in range(n):
+
+        def extract(i):
             if failed[i]:
-                results.append((None, False))
-                continue
+                results[i] = (None, False)
+                return
             # gate on the RAW window sequence count, like the reference
             # (cudabatch.cpp:214-222): layers skipped for length/depth
             # only reduce coverage, they do not demote the window
             if len(windows[i].sequences) < 3:
                 # <3 sequences -> backbone verbatim, unpolished
                 # (reference: cudabatch.cpp:214-222, window.cpp:68-71)
-                results.append((windows[i].sequences[0], False))
-                continue
+                results[i] = (windows[i].sequences[0], False)
+                return
             out = ctypes.create_string_buffer(out_cap)
             status = ctypes.c_int32(0)
             length = lib.rt_poab_consensus(
                 handle, i, windows[i].type.value, 1 if trim else 0,
                 out, out_cap, ctypes.byref(status))
             if length < 0:
-                results.append((None, False))
-                continue
+                results[i] = (None, False)
+                return
             if status.value == 2:
                 windows[i].warn_chimeric()
-            results.append((out.raw[:length], True))
+            results[i] = (out.raw[:length], True)
+
+        _map(pool, extract, range(n))
         return results
 
     @staticmethod
     def _pow2(n: int, lo: int) -> int:
-        b = lo
-        while b < n:
-            b <<= 1
-        return b
+        from racon_tpu.utils.tuning import pow2_at_least
+        return pow2_at_least(n, lo)
+
+    def _band_cols(self, l_b: int) -> int:
+        """Effective band width for layer bucket ``l_b`` (0 = unbanded:
+        the band would cover the whole row anyway)."""
+        return poa_band_cols(l_b, self.band_cols)
 
     def _dispatch(self, bases, preds, nrows, sinks, seq_arr, slen):
         # bucket this round's static dims to the active maxima so scan
         # length tracks real graph sizes, not the worst-case caps
         v_b = min(self._pow2(int(nrows.max()), 128), self.vcap)
         l_b = min(self._pow2(int(slen.max()), 128), self.lcap)
+        wb = self._band_cols(l_b)
+        self.cells += bases.shape[0] * v_b * (wb if wb else l_b + 1)
         args = (bases[:, :v_b], preds[:, :v_b, :], nrows,
                 sinks[:, :v_b], seq_arr[:, :l_b], slen)
         n_dev = len(self.mesh.devices) if self.mesh is not None else 1
@@ -411,13 +588,18 @@ class TPUPoaBatchEngine:
                     for a in args]
             node_tape, seq_tape = mesh_utils.sharded_poa(
                 self.mesh, *args, v=v_b, l=l_b, p=self.pcap,
-                k=self.kcap, match=self.match, mismatch=self.mismatch,
-                gap=self.gap)
+                k=self.kcap, wb=wb, match=self.match,
+                mismatch=self.mismatch, gap=self.gap)
             b = bases.shape[0]
             return np.asarray(node_tape)[:b], np.asarray(seq_tape)[:b]
-        node_tape, seq_tape = _poa_kernel(
-            *(jnp.asarray(a) for a in args), v_b, l_b, self.pcap,
-            self.kcap, self.match, self.mismatch, self.gap)
+        if wb:
+            node_tape, seq_tape = _poa_kernel_banded(
+                *(jnp.asarray(a) for a in args), v_b, l_b, self.pcap,
+                self.kcap, wb, self.match, self.mismatch, self.gap)
+        else:
+            node_tape, seq_tape = _poa_kernel(
+                *(jnp.asarray(a) for a in args), v_b, l_b, self.pcap,
+                self.kcap, self.match, self.mismatch, self.gap)
         return np.asarray(node_tape), np.asarray(seq_tape)
 
 

@@ -58,6 +58,11 @@ class TPUPolisher(Polisher):
         self.align_mem_budget = _env_int("RACON_TPU_ALIGN_BUDGET",
                                          self.ALIGN_MEM_BUDGET)
         self._mesh = None
+        # DP-cell counters + stage walls for throughput reporting
+        self.align_cells = 0
+        self.poa_cells = 0
+        self.poa_reject_counts = {}
+        self.stage_walls = {}
         from racon_tpu.utils.xla_cache import enable_compilation_cache
         enable_compilation_cache()
 
@@ -75,7 +80,32 @@ class TPUPolisher(Polisher):
     # depth cap per window, mirroring MAX_DEPTH_PER_WINDOW
     # (src/cuda/cudapolisher.cpp:229)
     MAX_DEPTH_PER_WINDOW = 200
-    POA_BATCH_SIZE = 128
+
+    def _poa_batch_size(self, vcap: int, lcap: int, n_dev: int) -> int:
+        """Windows per megabatch, derived from device memory split
+        across ``tpu_poa_batches`` batches — the analog of cudapoa's
+        ``mem_per_batch = 0.9 * free / cudapoa_batches``
+        (src/cuda/cudapolisher.cpp:231-242).  RACON_TPU_POA_BATCH
+        overrides."""
+        override = _env_int("RACON_TPU_POA_BATCH", 0)
+        if override > 0:
+            return override
+        try:
+            import jax
+            limit = jax.devices()[0].memory_stats()["bytes_limit"]
+        except Exception:
+            limit = 8 << 30  # backends without memory stats (CPU mesh)
+        from racon_tpu.utils.tuning import poa_band_cols
+        wb = poa_band_cols(
+            lcap, 128 if self.tpu_banded_alignment else 0) or (lcap + 1)
+        # per-lane round footprint: direction tape + score ring +
+        # predecessor lists + candidate temporaries (x2 safety)
+        bytes_per_lane = 2 * (vcap * wb + 128 * wb * 4
+                              + vcap * 16 * 2 + 40 * wb * 4)
+        mem_per_batch = 0.9 * limit * n_dev / max(
+            1, self.tpu_poa_batches)
+        b = int(mem_per_batch // bytes_per_lane)
+        return max(n_dev, min(b, 4096))
 
     def _poa_caps(self):
         """Device cap selection: power-of-two graph/layer caps scaled
@@ -89,15 +119,26 @@ class TPUPolisher(Polisher):
     def generate_consensuses(self) -> List[bool]:
         if self.tpu_poa_batches <= 0:
             return super().generate_consensuses()
+        import time
+        from jax.profiler import TraceAnnotation
+        t0 = time.monotonic()
+        with TraceAnnotation("racon_tpu.device_poa"):
+            flags = self._device_generate_consensuses()
+        self.stage_walls["device_poa"] = time.monotonic() - t0
+        return flags
 
+    def _device_generate_consensuses(self) -> List[bool]:
         from racon_tpu.tpu.poa import TPUPoaBatchEngine
 
         vcap, lcap = self._poa_caps()
-        batch_size = _env_int("RACON_TPU_POA_BATCH", self.POA_BATCH_SIZE)
         n_dev = len(self.mesh.devices)
+        batch_size = self._poa_batch_size(vcap, lcap, n_dev)
+        # -b narrows the POA band (cudapoa banded analog); default is
+        # the auto band (l_b/4, floor 256)
         engine = TPUPoaBatchEngine(
             self.match, self.mismatch, self.gap, vcap=vcap, pcap=16,
             lcap=lcap, kcap=128, max_depth=self.MAX_DEPTH_PER_WINDOW,
+            band_cols=128 if self.tpu_banded_alignment else 0,
             mesh=self.mesh if n_dev > 1 else None)
 
         # trivial windows (<3 sequences) keep the backbone and count as
@@ -147,6 +188,8 @@ class TPUPolisher(Polisher):
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::polish] skipped "
                 f"{engine.n_skipped_layers} over-long layer(s)")
+        self.poa_cells += engine.cells
+        self.poa_reject_counts = dict(engine.reject_counts)
         return flags
 
     # ------------------------------------------------------------------
@@ -155,7 +198,12 @@ class TPUPolisher(Polisher):
 
     def find_overlap_breaking_points(self, overlaps: List[Overlap]) -> None:
         if self.tpu_aligner_batches > 0:
-            self._device_align_overlaps(overlaps)
+            import time
+            from jax.profiler import TraceAnnotation
+            t0 = time.monotonic()
+            with TraceAnnotation("racon_tpu.device_align"):
+                self._device_align_overlaps(overlaps)
+            self.stage_walls["device_align"] = time.monotonic() - t0
         # CPU path computes breaking points for everything, running the
         # CPU aligner only for overlaps still lacking a CIGAR
         # (cudapolisher.cpp:212-216)
@@ -165,10 +213,8 @@ class TPUPolisher(Polisher):
     def _bucket_dim(n: int) -> int:
         """Round up to the power-of-two bucket (min 512) to bound the
         number of compiled kernel variants."""
-        b = 512
-        while b < n:
-            b <<= 1
-        return b
+        from racon_tpu.utils.tuning import pow2_at_least
+        return pow2_at_least(n, 512)
 
     def _device_align_overlaps(self, overlaps: List[Overlap]) -> None:
         pending = []  # (bucket_lq, bucket_lt, overlap)
@@ -179,7 +225,12 @@ class TPUPolisher(Polisher):
             lt = o.t_end - o.t_begin
             if max(lq, lt) > self.max_align_dim or min(lq, lt) == 0:
                 continue  # CPU fallback
-            pending.append((self._bucket_dim(lq), self._bucket_dim(lt), o))
+            # square buckets (max dim): with banded DP the padding on
+            # the smaller dim costs only extra scan steps, and merging
+            # asymmetric shapes avoids tiny batches each paying a full
+            # wavefront dispatch + its own compiled variant
+            bd = self._bucket_dim(max(lq, lt))
+            pending.append((bd, bd, o))
         if not pending:
             return
 
@@ -194,7 +245,9 @@ class TPUPolisher(Polisher):
             j = i
             while j < len(pending) and pending[j][:2] == (blq, blt):
                 j += 1
-            bytes_per_lane = (blq + blt) * ((blt + 4) // 4)
+            # banded ladder: most lanes finish at hw<=2048, so budget
+            # on that rung's packed-tape footprint
+            bytes_per_lane = (blq + blt) * ((min(2048, blt) + 5) // 4)
             max_b = max(n_dev, int(self.align_mem_budget // bytes_per_lane))
             max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
             for k in range(i, j, max_b):
@@ -217,24 +270,29 @@ class TPUPolisher(Polisher):
 
         queries = [o.query_span(self.sequences) for o in chunk]
         targets = [o.target_span(self.sequences) for o in chunk]
-        q = aligner.encode_batch(queries, blq, aligner._QPAD)
-        t = aligner.encode_batch(targets, blt, aligner._TPAD)
-        ql = np.array([len(s) for s in queries], dtype=np.int32)
-        tl = np.array([len(s) for s in targets], dtype=np.int32)
 
-        # pad the batch to a mesh-divisible size
-        q = mesh_utils.pad_to_multiple(q, n_dev, aligner._QPAD)
-        t = mesh_utils.pad_to_multiple(t, n_dev, aligner._TPAD)
-        ql = mesh_utils.pad_to_multiple(ql, n_dev, 1)
-        tl = mesh_utils.pad_to_multiple(tl, n_dev, 1)
-
+        dispatch = None
         if n_dev > 1:
             sharding = NamedSharding(self.mesh, P("batch"))
-            args = [jax.device_put(a, sharding) for a in (q, t, ql, tl)]
-            ops = mesh_utils.sharded_align(self.mesh, *args, lq=blq,
-                                           lt=blt)
-        else:
-            ops = aligner._align_kernel(q, t, ql, tl, blq, blt)
-        ops = np.asarray(ops)
+
+            def dispatch(q, t, ql, tl, lq, lt, hw):
+                args = [jax.device_put(
+                            mesh_utils.pad_to_multiple(a, n_dev, f),
+                            sharding)
+                        for a, f in ((q, aligner._QPAD),
+                                     (t, aligner._TPAD), (ql, 0),
+                                     (tl, 0))]
+                return mesh_utils.sharded_align(self.mesh, *args, lq=lq,
+                                                lt=lt, hw=hw)
+
+        # overlaps the ladder cannot resolve go to the CPU aligner
+        # (reference: exceeded_max_alignment_difference skip,
+        # src/cuda/cudaaligner.cpp:64-72 + cudapolisher.cpp:212-216)
+        ops, cells, unresolved = aligner.band_align_batch(
+            queries, targets, blq, blt, dispatch=dispatch,
+            allow_full=False, mem_budget=self.align_mem_budget)
+        self.align_cells += cells
+        skip = set(unresolved.tolist())
         for idx, o in enumerate(chunk):
-            o.cigar = aligner.ops_to_cigar(ops[idx])
+            if idx not in skip:
+                o.cigar = aligner.ops_to_cigar(ops[idx])

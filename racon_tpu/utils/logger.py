@@ -3,8 +3,10 @@
 Same observable behaviour as racon's Logger: ``log()`` (re)starts a stage
 timer, ``log(msg)`` prints the elapsed stage seconds to stderr, ``bar``
 renders a 20-bin progress bar that overwrites itself, and ``total``
-prints the cumulative wall clock.  On TPU runs, stage boundaries also
-emit jax.profiler trace annotations when profiling is enabled.
+prints the cumulative wall clock.  Device-stage jax.profiler trace
+annotations live at the dispatch sites (racon_tpu/tpu/polisher.py,
+racon_tpu/tpu/poa.py), the analog of the reference's nvprof ranges
+(src/cuda/cudapolisher.cpp:66-70).
 """
 
 from __future__ import annotations

@@ -1,0 +1,37 @@
+"""Shared device-kernel tuning knobs."""
+
+from __future__ import annotations
+
+import os
+
+
+def scan_unroll(default: int = 1) -> int:
+    """lax.scan unroll factor (RACON_TPU_SCAN_UNROLL overrides).
+
+    Measured on v5e: unroll>1 is neutral for the aligner wavefront and
+    mildly harmful for the POA rank scan (larger step bodies without
+    fewer effective syncs), so both default to 1; the env knob exists
+    for per-hardware re-measurement.
+    """
+    return int(os.environ.get("RACON_TPU_SCAN_UNROLL", default))
+
+
+def pow2_at_least(n: int, floor: int) -> int:
+    """Round ``n`` up to the next power of two, no lower than
+    ``floor`` — the bucketing used everywhere to bound the number of
+    compiled kernel shapes."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+def poa_band_cols(l_bucket: int, band_cols: int = 0) -> int:
+    """Effective POA band width for a layer bucket (0 = unbanded).
+
+    ``band_cols`` 0 selects the auto band (quarter of the bucket,
+    floor 256); the CLI's -b narrows it (the engine passes 128).  A
+    band at least as wide as the whole row degenerates to unbanded.
+    """
+    wb = band_cols if band_cols else max(256, l_bucket // 4)
+    return 0 if wb >= l_bucket + 1 else wb

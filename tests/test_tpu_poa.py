@@ -52,6 +52,29 @@ def test_device_poa_recovers_truth(depth, rate):
             f"cpu-engine distance {d_cpu}"
 
 
+@pytest.mark.parametrize("band_cols", [0, 128])
+def test_banded_device_poa_matches_cpu(band_cols):
+    """Realistic window-length layers (~550 bp -> l bucket 1024) so the
+    banded kernel actually engages (auto band 256 < l_b+1), at both the
+    auto and the -b narrow band width."""
+    rng = random.Random(21)
+    truth = random_seq(550, rng)
+    windows = [make_window(truth, 10, 0.1, rng) for _ in range(2)]
+
+    eng = TPUPoaBatchEngine(5, -4, -8, vcap=2048, pcap=16, lcap=1024,
+                            band_cols=band_cols)
+    assert eng._band_cols(1024) == (band_cols or 256)  # banding active
+    results = eng.consensus_batch(windows, trim=True)
+    for w, (cons, ok) in zip(windows, results):
+        assert ok and cons is not None
+        d_truth = cpu.edit_distance(cons, truth)
+        d_cpu = cpu.edit_distance(cons, cpu_consensus(w))
+        assert d_truth <= max(2, int(0.02 * len(truth))), \
+            f"truth distance {d_truth}"
+        assert d_cpu <= max(2, int(0.02 * len(truth))), \
+            f"cpu-engine distance {d_cpu}"
+
+
 def test_partial_span_layers():
     rng = random.Random(5)
     truth = random_seq(300, rng)
