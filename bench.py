@@ -44,7 +44,8 @@ def read_fasta_gz(path):
     return {k: b"".join(v).upper() for k, v in seqs.items()}
 
 
-def run_polish(tpu_poa_batches=0, tpu_aligner_batches=0, threads=8):
+def run_polish(tpu_poa_batches=0, tpu_aligner_batches=0, threads=8,
+               banded=False):
     from racon_tpu.core.polisher import PolisherType, create_polisher
 
     polisher = create_polisher(
@@ -53,6 +54,7 @@ def run_polish(tpu_poa_batches=0, tpu_aligner_batches=0, threads=8):
         os.path.join(DATA, "sample_layout.fasta.gz"),
         PolisherType.kC, 500, 10.0, 0.3, True, 5, -4, -8,
         num_threads=threads, tpu_poa_batches=tpu_poa_batches,
+        tpu_banded_alignment=banded,
         tpu_aligner_batches=tpu_aligner_batches)
     t0 = time.monotonic()
     polisher.initialize()
@@ -113,9 +115,35 @@ def main():
             "align_gcells_per_s": round(align_cps / 1e9, 3),
             "poa_gcells_per_s": round(poa_cps / 1e9, 3),
         }
+        tpu_ok = True
     except Exception as exc:  # TPU path unavailable -> report CPU path
         log(f"[bench] TPU path unavailable ({type(exc).__name__}: {exc})")
         accel_wall, accel_dist, extra = cpu_wall, cpu_dist, {}
+        tpu_ok = False
+
+    if tpu_ok:
+        # -b narrow-band variant (cudapoa banded-flag analog): measure
+        # its wall + accuracy so the speed/quality trade is on record.
+        # Isolated try: a banded-only failure (fresh compiles) must not
+        # discard the successful cold/warm results above.
+        try:
+            banded_wall, banded_out, bpol = run_polish(
+                tpu_poa_batches=1, tpu_aligner_batches=1, banded=True)
+            banded_dist = accuracy(banded_out)
+            log(f"[bench] TPU path (-b narrow band): {banded_wall:.2f}s, "
+                f"edit distance {banded_dist}, poa stage "
+                f"{bpol.stage_walls.get('device_poa', 0.0):.2f}s")
+            extra["banded_wall_s"] = round(banded_wall, 3)
+            extra["banded_edit_distance"] = int(banded_dist)
+        except Exception as exc:
+            log(f"[bench] banded variant skipped "
+                f"({type(exc).__name__}: {exc})")
+
+        try:
+            extra.update(scale_bench())
+        except Exception as exc:
+            log(f"[bench] scale bench skipped "
+                f"({type(exc).__name__}: {exc})")
 
     print(json.dumps({
         "metric": "sample_e2e_polish_wall_s",
@@ -127,6 +155,52 @@ def main():
         "cpu_edit_distance": int(cpu_dist),
         **extra,
     }))
+
+
+def scale_bench():
+    """Genome-scale synthetic workload (the sample's 96 windows
+    underfill the device; this measures realistic megabatch
+    utilization).  Disable with RACON_TPU_BENCH_SCALE=0."""
+    if os.environ.get("RACON_TPU_BENCH_SCALE", "1") == "0":
+        return {}
+    import tempfile
+
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.ops import cpu
+    from racon_tpu.tools import simulate
+
+    with tempfile.TemporaryDirectory(prefix="racon_scale_") as tmp:
+        reads, paf, draft = simulate.simulate(
+            tmp, genome_len=300_000, coverage=15, read_len=8000, seed=7)
+        truth = open(os.path.join(tmp, "genome.fasta"),
+                     "rb").read().split(b"\n")[1]
+
+        def run(poa, al):
+            pol = create_polisher(
+                reads, paf, draft, PolisherType.kC, 500, 10.0, 0.3,
+                True, 5, -4, -8, num_threads=8, tpu_poa_batches=poa,
+                tpu_aligner_batches=al)
+            t0 = time.monotonic()
+            pol.initialize()
+            out = pol.polish(True)
+            return time.monotonic() - t0, out
+
+        # TPU first: if the device path fails, bail before paying for
+        # the multi-minute CPU reference run
+        tpu_wall, tpu_out = run(1, 1)
+        d_tpu = cpu.edit_distance(tpu_out[0].data, truth)
+        cpu_wall, cpu_out = run(0, 0)
+        d_cpu = cpu.edit_distance(cpu_out[0].data, truth)
+        log(f"[bench] scale (300kb, 15x synthetic): CPU {cpu_wall:.1f}s"
+            f" (dist {d_cpu}), TPU {tpu_wall:.1f}s (dist {d_tpu}), "
+            f"speedup {cpu_wall / tpu_wall:.2f}x")
+        return {
+            "scale_cpu_wall_s": round(cpu_wall, 3),
+            "scale_tpu_wall_s": round(tpu_wall, 3),
+            "scale_speedup": round(cpu_wall / tpu_wall, 3),
+            "scale_tpu_edit_distance": int(d_tpu),
+            "scale_cpu_edit_distance": int(d_cpu),
+        }
 
 
 if __name__ == "__main__":

@@ -1,0 +1,128 @@
+"""Synthetic polishing-workload generator (genome + reads + PAF).
+
+The reference validates at scale on an E. coli ONT dataset fetched from
+S3 (reference: ci/gpu/build.sh:25-33); that network path is unavailable
+here, so this module synthesizes an equivalent workload: a random
+genome, a mutated draft (the polishing target), and error-laden reads
+whose true coordinates are known by construction — overlaps are emitted
+directly as PAF from the simulation truth, no mapper needed.
+
+Everything is seeded and deterministic, so scale benchmarks are
+reproducible run-to-run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Tuple
+
+import numpy as np
+
+_ACGT = np.frombuffer(b"ACGT", dtype=np.uint8)
+
+
+def _mutate(seq: np.ndarray, rate: float,
+            rng: np.random.Generator) -> np.ndarray:
+    """Apply substitutions/insertions/deletions at ``rate`` (split
+    evenly), the ONT-style error mix used by the window tests."""
+    r = rng.random(seq.size)
+    keep = r >= rate / 3                       # deletions
+    out = seq[keep]
+    r2 = rng.random(out.size)
+    subs = r2 < rate / 3
+    out = out.copy()
+    out[subs] = _ACGT[rng.integers(0, 4, int(subs.sum()))]
+    ins = r2 >= 1 - rate / 3
+    if ins.any():
+        pieces = []
+        last = 0
+        for idx in np.flatnonzero(ins):
+            pieces.append(out[last:idx + 1])
+            pieces.append(_ACGT[rng.integers(0, 4, 1)])
+            last = idx + 1
+        pieces.append(out[last:])
+        out = np.concatenate(pieces)
+    return out
+
+
+def simulate(out_dir: str, genome_len: int = 1_000_000,
+             coverage: int = 30, read_len: int = 10_000,
+             read_error: float = 0.10, draft_error: float = 0.02,
+             seed: int = 7) -> Tuple[str, str, str]:
+    """Write genome.fasta (truth), draft.fasta (mutated target),
+    reads.fastq and reads2draft.paf into ``out_dir``.
+
+    Returns (reads_path, paf_path, draft_path) ready for the polisher;
+    genome.fasta is the accuracy oracle.
+    """
+    rng = np.random.default_rng(seed)
+    os.makedirs(out_dir, exist_ok=True)
+    genome = _ACGT[rng.integers(0, 4, genome_len)]
+    draft = _mutate(genome, draft_error, rng)
+
+    genome_path = os.path.join(out_dir, "genome.fasta")
+    with open(genome_path, "wb") as fh:
+        fh.write(b">genome\n" + genome.tobytes() + b"\n")
+    draft_path = os.path.join(out_dir, "draft.fasta")
+    with open(draft_path, "wb") as fh:
+        fh.write(b">draft\n" + draft.tobytes() + b"\n")
+
+    n_reads = max(1, genome_len * coverage // read_len)
+    reads_path = os.path.join(out_dir, "reads.fastq")
+    paf_path = os.path.join(out_dir, "reads2draft.paf")
+    # PAF targets the DRAFT (what the polisher aligns against), whose
+    # coordinates drift from genome coordinates by the draft's indels;
+    # a single linear rescale leaves O(sqrt(p*L)) local drift, absorbed
+    # by the polisher's error threshold -- these are seed coordinates,
+    # not exact truth
+    dlen = draft.size
+    scale = dlen / genome_len
+    with open(reads_path, "wb") as rf, open(paf_path, "wb") as pf:
+        for i in range(n_reads):
+            start = int(rng.integers(0, max(1, genome_len - read_len)))
+            end = min(genome_len, start + read_len)
+            fwd = _mutate(genome[start:end], read_error, rng)
+            strand = b"+" if rng.random() < 0.5 else b"-"
+            if strand == b"-":
+                comp = np.empty_like(fwd)
+                for a, b in zip(b"ACGT", b"TGCA"):
+                    comp[fwd == a] = b
+                data = comp[::-1]
+            else:
+                data = fwd
+            name = b"read%06d" % i
+            qual = rng.integers(45, 75, data.size).astype(np.uint8) + 33
+            rf.write(b"@" + name + b"\n" + data.tobytes() + b"\n+\n"
+                     + qual.tobytes() + b"\n")
+            t_begin = int(start * scale)
+            t_end = min(dlen, int(end * scale))
+            pf.write(b"\t".join([
+                name, b"%d" % data.size, b"0", b"%d" % data.size,
+                strand, b"draft", b"%d" % dlen, b"%d" % t_begin,
+                b"%d" % t_end, b"%d" % (t_end - t_begin),
+                b"%d" % (t_end - t_begin), b"255"]) + b"\n")
+    return reads_path, paf_path, draft_path
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Generate a synthetic polishing workload "
+        "(genome truth, mutated draft, error-laden reads, truth PAF).")
+    p.add_argument("out_directory")
+    p.add_argument("--genome-length", type=int, default=1_000_000)
+    p.add_argument("--coverage", type=int, default=30)
+    p.add_argument("--read-length", type=int, default=10_000)
+    p.add_argument("--read-error", type=float, default=0.10)
+    p.add_argument("--draft-error", type=float, default=0.02)
+    p.add_argument("--seed", type=int, default=7)
+    a = p.parse_args(argv)
+    paths = simulate(a.out_directory, a.genome_length, a.coverage,
+                     a.read_length, a.read_error, a.draft_error, a.seed)
+    print("\n".join(paths), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

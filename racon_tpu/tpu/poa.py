@@ -34,6 +34,7 @@ import ctypes
 import functools
 import os
 import threading
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -410,6 +411,12 @@ class TPUPoaBatchEngine:
         # because export() runs on the polisher's thread pool
         self.reject_counts = {-1: 0, -2: 0, -3: 0}
         self._reject_lock = threading.Lock()
+        # per-phase wall accounting (cumulative over rounds):
+        # export/apply are host C++ graph work, dispatch is the blocking
+        # device step, extract is final consensus generation
+        self.phase_walls = {"export": 0.0, "dispatch": 0.0,
+                            "apply": 0.0, "extract": 0.0}
+        self.n_rounds = 0
 
     def consensus_batch(self, windows, trim: bool, pool=None) \
             -> List[Tuple[Optional[bytes], bool]]:
@@ -494,7 +501,9 @@ class TPUPoaBatchEngine:
                 seq_arr[i, :len(s)] = np.frombuffer(s, dtype=np.uint8)
                 slen[i] = len(s)
 
+            t0 = time.monotonic()
             _map(pool, export, active)
+            self.phase_walls["export"] += time.monotonic() - t0
             active = [i for i in active if not failed[i]]
             if not active:
                 continue
@@ -504,8 +513,11 @@ class TPUPoaBatchEngine:
             # (measured: compacting tail rounds to 32 lanes saved
             # nothing and the extra compiled shapes cost ~5s), so idle
             # lanes in late rounds ride along for free
+            t0 = time.monotonic()
             node_tape, seq_tape = self._dispatch(
                 bases, preds, nrows, sinks, seq_arr, slen)
+            self.phase_walls["dispatch"] += time.monotonic() - t0
+            self.n_rounds += 1
 
             def apply(i):
                 w = windows[i]
@@ -528,7 +540,9 @@ class TPUPoaBatchEngine:
                     q if q else b"\x00" * len(s), 1 if q else 0,
                     int(w.positions[li][0]))
 
+            t0 = time.monotonic()
             _map(pool, apply, active)
+            self.phase_walls["apply"] += time.monotonic() - t0
 
         # consensus extraction (pooled; the native call releases the GIL)
         results: List[Tuple[Optional[bytes], bool]] = [None] * n
@@ -558,7 +572,9 @@ class TPUPoaBatchEngine:
                 windows[i].warn_chimeric()
             results[i] = (out.raw[:length], True)
 
+        t0 = time.monotonic()
         _map(pool, extract, range(n))
+        self.phase_walls["extract"] += time.monotonic() - t0
         return results
 
     @staticmethod

@@ -190,6 +190,8 @@ class TPUPolisher(Polisher):
                 f"{engine.n_skipped_layers} over-long layer(s)")
         self.poa_cells += engine.cells
         self.poa_reject_counts = dict(engine.reject_counts)
+        self.poa_phase_walls = dict(engine.phase_walls)
+        self.poa_rounds = engine.n_rounds
         return flags
 
     # ------------------------------------------------------------------
