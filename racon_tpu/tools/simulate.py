@@ -86,10 +86,10 @@ def simulate(out_dir: str, genome_len: int = 1_000_000,
             fwd = _mutate(genome[start:end], read_error, rng)
             strand = b"+" if rng.random() < 0.5 else b"-"
             if strand == b"-":
-                comp = np.empty_like(fwd)
-                for a, b in zip(b"ACGT", b"TGCA"):
-                    comp[fwd == a] = b
-                data = comp[::-1]
+                from racon_tpu.core.sequence import _COMPLEMENT
+                data = np.frombuffer(
+                    fwd.tobytes().translate(_COMPLEMENT),
+                    np.uint8)[::-1]
             else:
                 data = fwd
             name = b"read%06d" % i

@@ -425,7 +425,31 @@ class TPUPoaBatchEngine:
         Returns one (consensus, polished) pair per window; consensus is
         None when the window overflowed the device caps and must be
         re-polished on the CPU (reference: cudapolisher.cpp:357-386).
+
+        On a real TPU backend the whole POA runs inside ONE Pallas
+        kernel call (racon_tpu/tpu/poa_pallas.py, the cudapoa-shaped
+        design); elsewhere (CPU mesh dryrun, multi-device shard_map)
+        the portable lockstep lax.scan engine below is used.
         """
+        if self.mesh is None:
+            from racon_tpu.tpu import poa_pallas
+            if poa_pallas.available():
+                # the kernel's window type is a compile-time constant;
+                # split mixed batches so each window trims per its own
+                # type (parity with the per-window lockstep/CPU paths)
+                types = {w.type.value for w in windows}
+                if len(types) <= 1:
+                    return self._run_full_device(windows, trim)
+                results: List[Tuple[Optional[bytes], bool]] = \
+                    [None] * len(windows)
+                for tv in sorted(types):
+                    idxs = [i for i, w in enumerate(windows)
+                            if w.type.value == tv]
+                    sub = self._run_full_device(
+                        [windows[i] for i in idxs], trim)
+                    for i, r in zip(idxs, sub):
+                        results[i] = r
+                return results
         n = len(windows)
         nb = _NativeBatch(n)
         try:
@@ -433,21 +457,110 @@ class TPUPoaBatchEngine:
         finally:
             nb.close()
 
+    # -- full on-device path (flagship Pallas kernel) ------------------
+
+    def _order_layers(self, w):
+        idx = sorted(range(1, len(w.sequences)),
+                     key=lambda i: w.positions[i][0])
+        kept = [i for i in idx
+                if len(w.sequences[i]) <= self.lcap][:self.max_depth]
+        self.n_skipped_layers += len(idx) - len(kept)
+        return kept
+
+    def _run_full_device(self, windows, trim) \
+            -> List[Tuple[Optional[bytes], bool]]:
+        from racon_tpu.tpu import poa_pallas
+        from racon_tpu.utils.tuning import pow2_at_least
+
+        n = len(windows)
+        layer_lists = [self._order_layers(w) for w in windows]
+        v, lp = self.vcap, self.lcap
+        # -b narrows the band; the on-device DP needs >= 256 columns
+        # (quantum 128), so the narrow setting clamps up
+        wb = max(256, ((self.band_cols or lp // 4) + 127) & ~127)
+        wb = min(wb, ((lp + 127) & ~127))
+        d1 = max(8, pow2_at_least(
+            max((len(ll) for ll in layer_lists), default=0) + 1, 8))
+        b_pad = max(8, pow2_at_least(n, 8))
+
+        t0 = time.monotonic()
+        seqs = np.zeros((b_pad, d1, lp), np.uint8)
+        wts = np.ones((b_pad, d1, lp), np.uint8)
+        meta = np.zeros((b_pad, d1, 8), np.int32)
+        nlay = np.zeros(b_pad, np.int32)
+        bblen = np.ones(b_pad, np.int32)
+        seqs[:, 0, 0] = ord("A")        # pad windows: 1-base backbone
+        host_fail = [False] * n
+        for b, w in enumerate(windows):
+            bb = w.sequences[0]
+            if len(bb) > min(lp, v):
+                host_fail[b] = True     # vcap analog, CPU re-polish
+                continue
+            bblen[b] = len(bb)
+            seqs[b, 0, :len(bb)] = np.frombuffer(bb, np.uint8)
+            q0 = w.qualities[0]
+            if q0:
+                wts[b, 0, :len(bb)] = \
+                    np.frombuffer(q0, np.uint8).astype(np.int32) \
+                    .clip(33, None).astype(np.uint8) - 33
+            offset = int(0.01 * len(bb))
+            nlay[b] = len(layer_lists[b])
+            for d, li in enumerate(layer_lists[b], start=1):
+                s = w.sequences[li]
+                seqs[b, d, :len(s)] = np.frombuffer(s, np.uint8)
+                ql = w.qualities[li]
+                if ql:
+                    wts[b, d, :len(s)] = \
+                        np.frombuffer(ql, np.uint8).astype(np.int32) \
+                        .clip(33, None).astype(np.uint8) - 33
+                begin, end = w.positions[li]
+                full = 1 if (begin < offset
+                             and end > len(bb) - offset) else 0
+                meta[b, d, :4] = (begin, end, full, len(s))
+        self.phase_walls["export"] += time.monotonic() - t0
+
+        t0 = time.monotonic()
+        cons, mout = poa_pallas.poa_full_batch(
+            seqs, wts, meta, nlay, bblen, v=v, lp=lp, d1=d1,
+            p=self.pcap, s=self.pcap, a=8, k=self.kcap, wb=wb,
+            match=self.match, mismatch=self.mismatch, gap=self.gap,
+            wtype=windows[0].type.value, trim=1 if trim else 0)
+        self.phase_walls["dispatch"] += time.monotonic() - t0
+        self.n_rounds += 1
+        self.cells += int(mout[:n, 4].sum()) * wb
+
+        t0 = time.monotonic()
+        results: List[Tuple[Optional[bytes], bool]] = []
+        code_map = {poa_pallas.FAIL_VCAP: -1, poa_pallas.FAIL_EDGE: -2,
+                    poa_pallas.FAIL_ALIGNED: -2,
+                    poa_pallas.FAIL_KCAP: -3, poa_pallas.FAIL_PATH: -3}
+        for b, w in enumerate(windows):
+            length = int(mout[b, 0])
+            if len(w.sequences) < 3:
+                # raw-count gate, like the reference
+                # (cudabatch.cpp:214-222): backbone verbatim, unpolished
+                results.append((w.sequences[0], False))
+                continue
+            if host_fail[b] or length < 0:
+                code = code_map.get(int(mout[b, 2]), -1)
+                with self._reject_lock:
+                    self.reject_counts[code] = \
+                        self.reject_counts.get(code, 0) + 1
+                results.append((None, False))
+                continue
+            if int(mout[b, 1]) == 2:
+                w.warn_chimeric()
+            results.append(
+                (bytes(cons[b, :length].astype(np.uint8)), True))
+        self.phase_walls["extract"] += time.monotonic() - t0
+        return results
+
     # -- helpers -------------------------------------------------------
 
     def _run(self, nb, windows, trim, pool):
         lib, handle = nb.lib, nb.handle
         n = len(windows)
-
-        def order_layers(w):
-            idx = sorted(range(1, len(w.sequences)),
-                         key=lambda i: w.positions[i][0])
-            kept = [i for i in idx
-                    if len(w.sequences[i]) <= self.lcap][:self.max_depth]
-            self.n_skipped_layers += len(idx) - len(kept)
-            return kept
-
-        layer_lists = [order_layers(w) for w in windows]
+        layer_lists = [self._order_layers(w) for w in windows]
 
         def seed(i):
             w = windows[i]

@@ -1,0 +1,842 @@
+"""Full on-device batched POA: the flagship Pallas TPU kernel.
+
+One grid program per window runs the ENTIRE partial-order-alignment
+consensus -- graph construction, per-layer banded DP, traceback, graph
+merge, heaviest-bundle consensus, TGS trim -- with the POA graph
+resident in VMEM/SMEM.  This is the cudapoa architecture (reference:
+one CUDA thread block per POA group, src/cuda/cudabatch.cpp:52-265)
+mapped to the TensorCore: host involvement is ONE upload of the layer
+sequences and ONE download of the finished consensus per megabatch.
+
+Why not the lockstep host-graph design (racon_tpu/tpu/poa.py)?  On the
+tunneled-TPU deployment target, host<->device transfers cost ~100 ms
+latency each way regardless of size; the lockstep engine pays two per
+layer round (~38 rounds on the reference sample workload), which
+dominates its wall clock.  This kernel pays two per megabatch.
+
+Graph representation (per program, V node slots):
+
+* per-node scalars in SMEM: base, anchor (backbone position), nseqs,
+  list-next, aligned-group-last, topo rank (epoch-tagged);
+* adjacency in VMEM int32 arrays: preds/pred weights [V,P], succs/succ
+  weights/succ anchors [V,S], aligned groups [V,A];
+* topological order is maintained as a singly-linked list grouped by
+  alignment column: new columns insert after the previous path node's
+  column, new aligned members insert adjacent to their column.  Edges
+  only ever point column-forward, so the list stays topologically
+  valid and each layer needs one O(V) walk instead of a Kahn sort
+  (spoa re-sorts per added sequence; cudapoa re-sorts on device).
+
+The per-layer DP is the same banded graph-vs-sequence recurrence as
+the scan kernels in poa.py (band quantum q = wb//4, pred rows fetched
+from a [K, wb] VMEM ring, in-row gap chain closed with a max-plus
+doubling scan), with first-slot-on-tie direction codes so tracebacks
+are deterministic.  Graph-semantics parity target is the native CPU
+engine (racon_tpu/native/poa_graph.hpp); like the CUDA path vs spoa,
+cost-equal alignment ties may resolve differently, so consensus
+equality is validated within an edit tolerance, not byte-for-byte.
+
+Windows that overflow any cap (V nodes, P/S edges, A aligned, K rank
+reach, path length) fail with a code and fall back to the CPU engine,
+the reference's rejection contract (cudabatch.cpp:124-155 ->
+cudapolisher.cpp:357-386).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BIG = 1 << 28
+_N_SHIFT = 4          # pred band may lag <= 3 quanta of 128
+_INF32 = np.int32(2147483647 // 2)
+
+# fail codes (observability parity with the lockstep export codes)
+FAIL_VCAP = 1
+FAIL_EDGE = 2         # pred/succ slot overflow (pcap analog)
+FAIL_KCAP = 3         # pred rank reach > K
+FAIL_ALIGNED = 4
+FAIL_PATH = 5
+
+
+def available() -> bool:
+    """True when the on-device POA path should be used: a real TPU
+    backend (the CPU mesh used for the multichip dryrun keeps the
+    portable lax.scan lockstep engine) and not explicitly disabled."""
+    if os.environ.get("RACON_TPU_NO_PALLAS"):
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _kernel(nlay_ref, bblen_ref,
+            seqs_ref, wts_ref, meta_ref,
+            cons_ref, mout_ref,
+            preds_v, predw_v, succs_v, succw_v, succanch_v,
+            alig_v, ring_v, dirs, accs, arga, path_v,
+            base_s, anch_s, nseq_s, nxt_s, glast_s,
+            bandq_s, pcnt_s, scnt_s, predsm_s, order_s, sinkr_s,
+            score_s, cpred_s, regs_s, *,
+            v: int, lp: int, d1: int, p: int, s_: int, a_: int,
+            k: int, wb: int, n_sl: int,
+            match: int, mismatch: int, gap: int,
+            wtype: int, trim: int):
+    i = pl.program_id(0)
+    nlay = nlay_ref[i]
+    bbl = bblen_ref[i]
+    q = 128               # band-start quantum: 128-aligned lane slices
+                          # are free; 64-offset slices cost a rotation
+    tape = v + lp
+    negf = jnp.float32(-float(_BIG))
+    matchf = jnp.float32(match)
+    mismatchf = jnp.float32(mismatch)
+    gapf = jnp.float32(gap)
+    cols_i = lax.broadcasted_iota(jnp.int32, (1, wb), 1)
+    colsf = cols_i.astype(jnp.float32)
+    iota_p = lax.broadcasted_iota(jnp.int32, (1, p), 1)
+    iota_s = lax.broadcasted_iota(jnp.int32, (1, s_), 1)
+    iota_a = lax.broadcasted_iota(jnp.int32, (1, a_), 1)
+    iota_lp = lax.broadcasted_iota(jnp.int32, (1, lp), 1)
+    # path pack radix: entry = (node+2)*pkr + (spos+2); spos < lp and
+    # node < v, so pkr must clear lp (the wrapper asserts the product
+    # fits int32)
+    pkr = 1
+    while pkr < lp + 8:
+        pkr <<= 1
+
+    # ---- scratch bulk init (scratch persists across grid programs) --
+    preds_v[:, :] = jnp.full((v, p), -1, jnp.int32)
+    predw_v[:, :] = jnp.zeros((v, p), jnp.int32)
+    succs_v[:, :] = jnp.full((v, s_), -1, jnp.int32)
+    succw_v[:, :] = jnp.zeros((v, s_), jnp.int32)
+    succanch_v[:, :] = jnp.full((v, s_), _INF32, jnp.int32)
+    alig_v[:, :] = jnp.full((v, a_), -1, jnp.int32)
+
+    def init_bandq(j, _):
+        bandq_s[j] = jnp.int32(-1)
+        return 0
+
+    lax.fori_loop(0, v, init_bandq, 0)
+
+    # regs: 0 fail, 1 head, 2 nodes_len, 3 n_seqs_incl, 4 rank_steps
+    regs_s[0] = jnp.int32(0)
+    regs_s[3] = jnp.int32(1)
+    regs_s[4] = jnp.int32(0)
+
+    def e11(val2d):
+        """(1,1) value -> scalar."""
+        return val2d[0, 0]
+
+    def vload(ref, row):
+        return ref[pl.ds(row, 1), :]
+
+    def min_idx(mask, width, iota_row):
+        """First lane index where mask is true, else width."""
+        return e11(jnp.min(jnp.where(mask, iota_row, width),
+                           axis=1, keepdims=True))
+
+    def ext_lane(row, j):
+        """row[0, j] for dynamic j via a masked reduction (dynamic
+        lane indexing is not addressable on TPU)."""
+        return e11(jnp.sum(jnp.where(iota_lp == j, row, 0), axis=1,
+                           keepdims=True))
+
+    # ---- seed the backbone chain (add_alignment with an empty path:
+    # racon_tpu/native/poa_graph.hpp add_alignment initial branch) ----
+    srow0 = seqs_ref[0, 0:1, :]                 # [1, LP]
+    wrow0 = wts_ref[0, 0:1, :]
+
+    @pl.when(bbl > v)
+    def _():
+        regs_s[0] = jnp.int32(FAIL_VCAP)
+
+    def seed(j, prev_w):
+        c = ext_lane(srow0, j)
+        w = ext_lane(wrow0, j)
+        base_s[j] = c
+        anch_s[j] = j
+        nseq_s[j] = jnp.int32(1)
+        nxt_s[j] = jnp.where(j + 1 < bbl, j + 1, -1)
+        glast_s[j] = j
+        pcnt_s[j] = jnp.where(j > 0, 1, 0)
+        scnt_s[j] = jnp.where(j + 1 < bbl, 1, 0)
+        predsm_s[j * 4] = j - 1
+        predsm_s[j * 4 + 1] = jnp.int32(-1)
+        predsm_s[j * 4 + 2] = jnp.int32(-1)
+        predsm_s[j * 4 + 3] = jnp.int32(-1)
+
+        @pl.when(j > 0)
+        def _():
+            succs_v[pl.ds(j - 1, 1), 0:1] = jnp.full((1, 1), j,
+                                                     jnp.int32)
+            succw_v[pl.ds(j - 1, 1), 0:1] = jnp.full((1, 1),
+                                                     prev_w + w,
+                                                     jnp.int32)
+            succanch_v[pl.ds(j - 1, 1), 0:1] = jnp.full((1, 1), j,
+                                                        jnp.int32)
+            preds_v[pl.ds(j, 1), 0:1] = jnp.full((1, 1), j - 1,
+                                                 jnp.int32)
+            predw_v[pl.ds(j, 1), 0:1] = jnp.full((1, 1), prev_w + w,
+                                                 jnp.int32)
+        return w
+
+    lax.fori_loop(0, jnp.minimum(bbl, v), seed, jnp.int32(0))
+    regs_s[1] = jnp.int32(0)                   # list head
+    regs_s[2] = jnp.minimum(bbl, v)            # nodes_len
+
+    # ---- helpers shared by the merge step ---------------------------
+
+    def insert_after(pos, node):
+        """Linked-list insert; pos == -1 -> new head."""
+        @pl.when(pos >= 0)
+        def _():
+            nxt_s[node] = nxt_s[pos]
+            nxt_s[pos] = node
+
+        @pl.when(pos < 0)
+        def _():
+            nxt_s[node] = regs_s[1]
+            regs_s[1] = node
+
+    def new_node(c, anchor, pos):
+        """Allocate a node and insert it after list position pos."""
+        nid = regs_s[2]
+        ok = nid < v
+
+        @pl.when(ok)
+        def _():
+            base_s[nid] = c
+            anch_s[nid] = anchor
+            nseq_s[nid] = jnp.int32(0)
+            glast_s[nid] = nid
+            bandq_s[nid] = jnp.int32(-1)
+            pcnt_s[nid] = jnp.int32(0)
+            scnt_s[nid] = jnp.int32(0)
+            predsm_s[nid * 4] = jnp.int32(-1)
+            predsm_s[nid * 4 + 1] = jnp.int32(-1)
+            predsm_s[nid * 4 + 2] = jnp.int32(-1)
+            predsm_s[nid * 4 + 3] = jnp.int32(-1)
+            regs_s[2] = nid + 1
+            insert_after(pos, nid)
+
+        @pl.when(jnp.logical_not(ok))
+        def _():
+            regs_s[0] = jnp.int32(FAIL_VCAP)
+        return jnp.where(ok, nid, 0)
+
+    def add_edge(u, t, w):
+        """poa_graph.hpp add_edge: accumulate weight on an existing
+        u->t edge else append (succ side + pred-side mirror)."""
+        srow = vload(succs_v, u)
+        hit = min_idx(srow == t, s_, iota_s)
+
+        @pl.when(hit < s_)
+        def _():
+            roww = vload(succw_v, u)
+            succw_v[pl.ds(u, 1), :] = jnp.where(iota_s == hit,
+                                                roww + w, roww)
+            prow = vload(preds_v, t)
+            phit = min_idx(prow == u, p, iota_p)
+            prww = vload(predw_v, t)
+            predw_v[pl.ds(t, 1), :] = jnp.where(iota_p == phit,
+                                                prww + w, prww)
+
+        @pl.when(hit >= s_)
+        def _():
+            free = scnt_s[u]
+            prow = vload(preds_v, t)
+            pfree = pcnt_s[t]
+            okk = (free < s_) & (pfree < p)
+
+            @pl.when(okk)
+            def _():
+                succs_v[pl.ds(u, 1), :] = jnp.where(iota_s == free, t,
+                                                    srow)
+                roww = vload(succw_v, u)
+                succw_v[pl.ds(u, 1), :] = jnp.where(iota_s == free, w,
+                                                    roww)
+                rowa = vload(succanch_v, u)
+                succanch_v[pl.ds(u, 1), :] = jnp.where(
+                    iota_s == free, anch_s[t], rowa)
+                preds_v[pl.ds(t, 1), :] = jnp.where(iota_p == pfree, u,
+                                                    prow)
+                prww = vload(predw_v, t)
+                predw_v[pl.ds(t, 1), :] = jnp.where(iota_p == pfree, w,
+                                                    prww)
+                scnt_s[u] = free + 1
+                pcnt_s[t] = pfree + 1
+
+                @pl.when(pfree < 4)
+                def _():
+                    predsm_s[t * 4 + pfree] = u
+
+            @pl.when(jnp.logical_not(okk))
+            def _():
+                regs_s[0] = jnp.int32(FAIL_EDGE)
+
+    # ---- per-layer loop ---------------------------------------------
+
+    def layer(d, _):
+        @pl.when(regs_s[0] == 0)
+        def _do_layer():
+            mrow = meta_ref[0, pl.ds(d, 1), :]      # [1, 8]
+            begin = mrow[0, 0]
+            end = mrow[0, 1]
+            fsp = mrow[0, 2]
+            m = mrow[0, 3]
+            regs_s[3] = regs_s[3] + jnp.where(m > 0, 1, 0)
+            wrow_l = wts_ref[0, pl.ds(d, 1), :]     # [1, LP]
+
+            # 1) list walk: subset ranks + per-rank sink flags
+            end_eff = jnp.where(fsp > 0, _INF32 - 1, end)
+
+            def wcond(c):
+                return c[0] >= 0
+
+            def wbody(c):
+                node, r = c
+                anc = anch_s[node]
+                in_sub = (fsp > 0) | ((anc >= begin) & (anc <= end))
+
+                @pl.when(in_sub)
+                def _():
+                    order_s[r] = node
+                    minanch = e11(jnp.min(vload(succanch_v, node),
+                                          axis=1, keepdims=True))
+                    sinkr_s[r] = jnp.where(minanch > end_eff, 1, 0)
+                return nxt_s[node], r + jnp.where(in_sub, 1, 0)
+
+            _, nrank = lax.while_loop(wcond, wbody,
+                                      (regs_s[1], jnp.int32(0)))
+            regs_s[4] = regs_s[4] + nrank
+
+            # 2) banded DP over subset ranks (same recurrence as
+            # poa.py _poa_kernel_banded, one window instead of a batch)
+            nr = jnp.maximum(nrank, 1)
+            smax_q = (jnp.maximum(m + 1 - wb, 0) + q - 1) // q
+
+            def sqq(r):
+                # subtract q/2 (not wb/2): with quantum q the start
+                # rounds DOWN up to q-1 further, so centering on wb/2
+                # can leave a 1-column right margin; q/2 keeps both
+                # margins >= ~q/2 for wb = 2q
+                return jnp.clip(((r * m) // nr - (q // 2)) // q, 0,
+                                smax_q)
+
+            # u-space char table: sls[sq][c'] = seq[sq*q + c']
+            srow_l = seqs_ref[0, pl.ds(d, 1), :]       # [1, LP]
+            spadl = jnp.pad(srow_l, ((0, 0), (0, wb)),
+                            constant_values=0)
+            sls = [spadl[:, mm * q: mm * q + wb] for mm in range(n_sl)]
+
+            def pred_fold(pid, sq_r):
+                """One predecessor's H row realigned to this rank's
+                band, in vert space (u[c] = H_pred[s_r + c]); the diag
+                view is u shifted by one, applied once per rank after
+                the fold since the shift commutes with the max."""
+                be = bandq_s[jnp.maximum(pid, 0)]
+                valid = (pid >= 0) & ((be >> 8) == d)
+                g = ring_v[pl.ds(jnp.maximum(pid, 0), 1), :]
+                dq = sq_r - (be & 255)
+                gp = jnp.pad(g, ((0, 0), (0, _N_SHIFT * q)),
+                             constant_values=negf)
+                hv = jnp.full((1, wb), negf, jnp.float32)
+                for mm in range(_N_SHIFT):
+                    sel = valid & (dq == mm)
+                    hv = jnp.where(sel, gp[:, mm * q: mm * q + wb], hv)
+                # a predecessor whose band lags out of shift range
+                # cannot contribute; silently degrading would corrupt
+                # the consensus, so the window must fail to the CPU
+                # engine (the lockstep path's kcap reject analog)
+                bad = valid & ((dq < 0) | (dq >= _N_SHIFT))
+                return hv, jnp.where(valid, 1, 0), bad
+
+            def acc_update(hv, t):
+                a0 = accs[0:1, :]
+                up = hv > a0
+                accs[0:1, :] = jnp.where(up, hv, a0)
+                arga[0:1, :] = jnp.where(up, t, arga[0:1, :])
+
+            def rank_body(r, _):
+                sq_r = sqq(r)
+                s_r = sq_r * q
+                node = order_s[r - 1]
+                cnt = pcnt_s[node]
+                accs[0:1, :] = jnp.full((1, wb), negf, jnp.float32)
+                arga[0:1, :] = jnp.zeros((1, wb), jnp.int32)
+                nreal = jnp.int32(0)
+                nbad = jnp.int32(0)
+                # common case: <= 4 preds, ids mirrored in SMEM so the
+                # loop never syncs vector->scalar
+                for t in range(4):
+                    pid = jnp.where(t < cnt, predsm_s[node * 4 + t],
+                                    -1)
+                    hv, nv, bad = pred_fold(pid, sq_r)
+                    acc_update(hv, t)
+                    nreal = nreal + nv
+                    nbad = nbad + jnp.where(bad, 1, 0)
+
+                @pl.when(nbad > 0)
+                def _():
+                    regs_s[0] = jnp.int32(FAIL_KCAP)
+
+                @pl.when(cnt > 4)
+                def _deep():
+                    # rare: in-degree > 4, remaining slots from VMEM
+                    prow = vload(preds_v, node)
+
+                    def deep_step(t, nr2):
+                        pid = e11(jnp.sum(
+                            jnp.where(iota_p == t, prow, 0), axis=1,
+                            keepdims=True))
+                        hv, nv, bad = pred_fold(pid, sq_r)
+                        acc_update(hv, t)
+
+                        @pl.when(bad)
+                        def _():
+                            regs_s[0] = jnp.int32(FAIL_KCAP)
+                        return nr2 + nv
+
+                    nr2 = lax.fori_loop(4, cnt, deep_step,
+                                        jnp.int32(0))
+                    regs_s[5] = nr2
+
+                @pl.when(cnt <= 4)
+                def _():
+                    regs_s[5] = jnp.int32(0)
+                nreal = nreal + regs_s[5]
+
+                # no in-subset predecessor: virtual start row
+                # (poa_graph.hpp pred_rows empty -> [0]); in vert
+                # space the virtual row is exactly (s_r + c) * gap
+                novel = nreal == 0
+                vv = (s_r + cols_i).astype(jnp.float32) * gapf
+                accu = jnp.where(novel, vv, accs[0:1, :])
+                argu = jnp.where(novel, 0, arga[0:1, :])
+
+                sb = sls[0]
+                for mm in range(1, n_sl):
+                    sb = jnp.where(sq_r == mm, sls[mm], sb)
+                base_r = base_s[node]
+                # sub in u space: scored char at column c'+1 = seq
+                # position s_r + c'
+                j_u = s_r + cols_i
+                sub_u = jnp.where((j_u < m) & (sb == base_r), matchf,
+                                  mismatchf)
+
+                dmax_u = accu + sub_u
+                vmax = accu + gapf
+                dmax = jnp.pad(dmax_u, ((0, 0), (1, 0)),
+                               constant_values=negf)[:, :wb]
+                argd = jnp.pad(argu, ((0, 0), (1, 0)),
+                               constant_values=0)[:, :wb]
+                t_best = jnp.maximum(dmax, vmax)
+                x = t_best - colsf * gapf
+                sh = 1
+                while sh < wb:
+                    x = jnp.maximum(
+                        x, jnp.pad(x, ((0, 0), (sh, 0)),
+                                   constant_values=negf)[:, :wb])
+                    sh <<= 1
+                hr = x + colsf * gapf
+                code = jnp.where(
+                    dmax == hr, argd,
+                    jnp.where(vmax == hr, argu + p,
+                              2 * p)).astype(jnp.int32)
+                dirs[pl.ds(node, 1), :] = code
+                ring_v[pl.ds(node, 1), :] = hr
+                bandq_s[node] = (d << 8) | sq_r
+                return 0
+
+            lax.fori_loop(1, nrank + 1, rank_body, 0)
+
+            # sink fold after the loop: only sink ranks pay the
+            # vector->scalar score extraction
+            regs_s[6] = jnp.int32(-1)          # best sink node
+            regs_s[7] = jnp.int32(-_BIG)       # best score (int cast)
+
+            def sink_scan(r, _):
+                @pl.when(sinkr_s[r - 1] > 0)
+                def _():
+                    node = order_s[r - 1]
+                    s_r = (bandq_s[node] & 255) * q
+                    c_end = m - s_r
+
+                    @pl.when(c_end < wb)
+                    def _():
+                        hrow = ring_v[pl.ds(node, 1), :]
+                        ccl = jnp.clip(c_end, 0, wb - 1)
+                        s_end = jnp.sum(jnp.where(
+                            cols_i == ccl, hrow,
+                            jnp.float32(0))).astype(jnp.int32)
+
+                        @pl.when(s_end > regs_s[7])
+                        def _():
+                            regs_s[7] = s_end
+                            regs_s[6] = node
+                return 0
+
+            lax.fori_loop(1, nrank + 1, sink_scan, 0)
+            best_node = regs_s[6]
+
+
+            # 3) traceback -> reversed path in path_v, packed as
+            # (node+2)*pkr + (spos+2); node -1 = no node (horiz),
+            # carried node -1 = virtual start row
+            def tb_cond(c):
+                node, j, step = c
+                return ((node >= 0) | (j > 0)) & (step < tape)
+
+            def tb_body(c):
+                node, j, step = c
+                nodec = jnp.maximum(node, 0)
+                be = bandq_s[nodec]
+                s0 = jnp.where(node >= 0, be & 255, 0) * q
+                cc = jnp.clip(j - s0, 0, wb - 1)
+                drow = dirs[pl.ds(nodec, 1), :]
+                code = jnp.sum(jnp.where(cols_i == cc, drow, 0))
+                is_diag = (code < p) & (node >= 0)
+                is_vert = (code >= p) & (code < 2 * p) & (node >= 0)
+                take = is_diag | is_vert
+                slot = jnp.clip(jnp.where(is_diag, code, code - p),
+                                0, p - 1)
+                prow = vload(preds_v, nodec)
+                pid = jnp.sum(jnp.where(iota_p == slot, prow, 0))
+                pvalid = (pid >= 0) & \
+                    ((bandq_s[jnp.maximum(pid, 0)] >> 8) == d)
+                pnode = jnp.where(pvalid, pid, -1)
+                en = jnp.where(take, node, -1)
+                es = jnp.where(is_vert, -1, j - 1)
+                path_v[pl.ds(step, 1), 0:1] = jnp.full(
+                    (1, 1), (en + 2) * pkr + (es + 2), jnp.int32)
+                nn = jnp.where(take, pnode, node)
+                nj = jnp.where(is_vert, j, jnp.maximum(j - 1, 0))
+                return nn, nj, step + 1
+
+            _, _, plen = lax.while_loop(
+                tb_cond, tb_body, (best_node, m, jnp.int32(0)))
+
+            @pl.when(plen >= tape)
+            def _():
+                regs_s[0] = jnp.int32(FAIL_PATH)
+
+            # 4) merge (poa_graph.hpp add_alignment), walking the
+            # reversed path backward = forward order
+            def merge(t, carry):
+                prev, prev_w = carry
+                idx = plen - 1 - t
+                packed = e11(path_v[pl.ds(idx, 1), 0:1])
+                nid = packed // pkr - 2
+                j = packed % pkr - 2
+
+                def with_char(_):
+                    c = ext_lane(srow_l, j)
+                    w = ext_lane(wrow_l, j)
+
+                    def t_new(_):
+                        anchor = jnp.where(
+                            prev < 0, begin,
+                            anch_s[jnp.maximum(prev, 0)])
+                        pos = jnp.where(
+                            prev < 0, -1,
+                            glast_s[jnp.maximum(prev, 0)])
+                        return new_node(c, anchor, pos)
+
+                    def t_existing(_):
+                        def t_same(_):
+                            return nid
+
+                        def t_aligned(_):
+                            # mismatch: reuse an aligned sibling with
+                            # the same base else create one
+                            # (poa_graph.hpp aligned-group branch)
+                            arow = vload(alig_v, nid)
+                            found = jnp.int32(-1)
+                            for aa in range(a_):
+                                sib = arow[0, aa]
+                                okb = (sib >= 0) & (found < 0) & \
+                                    (base_s[jnp.maximum(sib, 0)] == c)
+                                found = jnp.where(okb, sib, found)
+
+                            def mk_new(_):
+                                tgt = new_node(c, anch_s[nid],
+                                               glast_s[nid])
+                                nslot = min_idx(arow < 0, a_, iota_a)
+                                grp_ok = nslot < a_
+
+                                @pl.when(jnp.logical_not(grp_ok))
+                                def _():
+                                    regs_s[0] = jnp.int32(FAIL_ALIGNED)
+
+                                @pl.when(grp_ok)
+                                def _():
+                                    # new node's group = arow + nid
+                                    trow = jnp.where(iota_a == nslot,
+                                                     nid, arow)
+                                    alig_v[pl.ds(tgt, 1), :] = trow
+                                    # append tgt to each member + nid
+                                    for aa in range(a_):
+                                        sib = arow[0, aa]
+
+                                        @pl.when(sib >= 0)
+                                        def _(sib=sib):
+                                            sr = vload(alig_v, sib)
+                                            fs = min_idx(sr < 0, a_,
+                                                         iota_a)
+                                            alig_v[pl.ds(sib, 1),
+                                                   :] = jnp.where(
+                                                iota_a == fs, tgt, sr)
+                                            glast_s[sib] = tgt
+                                    nrow2 = vload(alig_v, nid)
+                                    fs2 = min_idx(nrow2 < 0, a_,
+                                                  iota_a)
+
+                                    @pl.when(fs2 >= a_)
+                                    def _():
+                                        regs_s[0] = jnp.int32(
+                                            FAIL_ALIGNED)
+
+                                    @pl.when(fs2 < a_)
+                                    def _():
+                                        alig_v[pl.ds(nid, 1),
+                                               :] = jnp.where(
+                                            iota_a == fs2, tgt, nrow2)
+                                    glast_s[nid] = tgt
+                                return tgt
+
+                            return lax.cond(found >= 0,
+                                            lambda _: found,
+                                            mk_new, 0)
+
+                        return lax.cond(base_s[nid] == c, t_same,
+                                        t_aligned, 0)
+
+                    target = lax.cond(nid < 0, t_new, t_existing, 0)
+                    nseq_s[target] = nseq_s[target] + 1
+
+                    @pl.when(prev >= 0)
+                    def _():
+                        add_edge(prev, target, prev_w + w)
+                    return target, w
+
+                return lax.cond(j >= 0, with_char,
+                                lambda _: (prev, prev_w), 0)
+
+            lax.fori_loop(0, plen, merge,
+                          (jnp.int32(-1), jnp.int32(0)))
+        return 0
+
+    lax.fori_loop(1, nlay + 1, layer, 0)
+
+    # ---- consensus: heaviest bundle over the full graph -------------
+    fail = regs_s[0]
+
+    mout_ref[0, :, :] = jnp.zeros((8, 1), jnp.int32)
+    mout_ref[0, 0:1, 0:1] = jnp.full((1, 1),
+                                     jnp.where(fail == 0, 0, -1),
+                                     jnp.int32)
+    mout_ref[0, 2:3, 0:1] = jnp.full((1, 1), fail, jnp.int32)
+    mout_ref[0, 3:4, 0:1] = jnp.full((1, 1), regs_s[2], jnp.int32)
+    mout_ref[0, 4:5, 0:1] = jnp.full((1, 1), regs_s[4], jnp.int32)
+
+    @pl.when(fail == 0)
+    def _consensus():
+        # walk the list once for a full topo order
+        def wcond(c):
+            return c[0] >= 0
+
+        def wbody(c):
+            node, r = c
+            order_s[r] = node
+            return nxt_s[node], r + 1
+
+        _, n_all = lax.while_loop(wcond, wbody,
+                                  (regs_s[1], jnp.int32(0)))
+
+        # forward DP: per node pick the heaviest in-edge (ties ->
+        # higher predecessor score; slot order = insertion order,
+        # matching poa_graph.hpp consensus_path)
+        def cdp(r, best_sink):
+            node = order_s[r]
+            prow = vload(preds_v, node)
+            wrow = vload(predw_v, node)
+            best_w = jnp.int32(-1)
+            best_u = jnp.int32(-1)
+            for pp in range(p):
+                pid = prow[0, pp]
+                w = wrow[0, pp]
+                sc = score_s[jnp.maximum(pid, 0)]
+                bsc = score_s[jnp.maximum(best_u, 0)]
+                tk = (pid >= 0) & ((w > best_w) |
+                                   ((w == best_w) & (best_u >= 0) &
+                                    (sc > bsc)))
+                best_u = jnp.where(tk, pid, best_u)
+                best_w = jnp.where(tk, w, best_w)
+            score_s[node] = jnp.where(
+                best_u >= 0,
+                score_s[jnp.maximum(best_u, 0)] + best_w, 0)
+            cpred_s[node] = best_u
+            minanch = e11(jnp.min(vload(succanch_v, node), axis=1,
+                                  keepdims=True))
+            is_sink = minanch >= _INF32
+            better = is_sink & (
+                (best_sink < 0) |
+                (score_s[node] > score_s[jnp.maximum(best_sink, 0)]))
+            return jnp.where(better, node, best_sink)
+
+        best_sink = lax.fori_loop(0, n_all, cdp, jnp.int32(-1))
+
+        # backtrack into pthn_v (reversed), then emit forward
+        def bcond(c):
+            return c[0] >= 0
+
+        def bbody(c):
+            node, ln = c
+            path_v[pl.ds(ln, 1), 0:1] = jnp.full(
+                (1, 1), (node + 2) * pkr + 2, jnp.int32)
+            return cpred_s[node], ln + 1
+
+        _, clen = lax.while_loop(bcond, bbody,
+                                 (best_sink, jnp.int32(0)))
+
+        # TGS trim (rt_poab_consensus: threshold (n_seqs - 1) / 2)
+        avg = (regs_s[3] - 1) // 2
+
+        def scan_fwd(t, first):
+            idx = clen - 1 - t            # forward position t
+            node = e11(path_v[pl.ds(idx, 1), 0:1]) // pkr - 2
+            cov = nseq_s[node]
+            hit = (first < 0) & (cov >= avg)
+            return jnp.where(hit, t, first)
+
+        def scan_bwd(t, last):
+            node = e11(path_v[pl.ds(t, 1), 0:1]) // pkr - 2
+            cov = nseq_s[node]
+            hit = (last < 0) & (cov >= avg)
+            return jnp.where(hit, clen - 1 - t, last)
+
+        if wtype == 1 and trim:
+            cbegin = lax.fori_loop(0, clen, scan_fwd, jnp.int32(-1))
+            cend = lax.fori_loop(0, clen, scan_bwd, jnp.int32(-1))
+            chim = (cbegin < 0) | (cend < 0) | (cbegin >= cend)
+            cbegin = jnp.where(chim, 0, cbegin)
+            cend = jnp.where(chim, clen - 1, cend)
+            status = jnp.where(chim, 2, 0).astype(jnp.int32)
+        else:
+            cbegin = jnp.int32(0)
+            cend = clen - 1
+            status = jnp.int32(0)
+
+        length = jnp.maximum(cend - cbegin + 1, 0)
+
+        def emit(t, _):
+            node = e11(path_v[pl.ds(clen - 1 - (cbegin + t), 1),
+                              0:1]) // pkr - 2
+            cons_ref[0, pl.ds(t, 1), 0:1] = jnp.full(
+                (1, 1), base_s[node], jnp.int32)
+            return 0
+
+        lax.fori_loop(0, length, emit, 0)
+        mout_ref[0, 0:1, 0:1] = jnp.full((1, 1), length, jnp.int32)
+        mout_ref[0, 1:2, 0:1] = jnp.full((1, 1), status, jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17))
+def _poa_full(seqs, wts, meta, nlay, bblen,
+              v: int, lp: int, d1: int, p: int, s_: int, a_: int,
+              k: int, wb: int, match: int, mismatch: int, gap: int,
+              wtype: int, trim: int):
+    """seqs/wts: [B, D1, LP] uint8 (d=0 = backbone), meta: [B, D1, 8]
+    int32 (begin, end, full_span, slen, ...), nlay/bblen: [B] int32.
+    Returns (cons [B, V, 1] int32, mout [B, 8, 1] int32)."""
+    b = seqs.shape[0]
+    q = 128
+    n_sl = (max(0, lp + 1 - wb) + q - 1) // q + 1
+    pkr = 1
+    while pkr < lp + 8:
+        pkr <<= 1
+    assert (v + 2) * pkr < 2 ** 31, "path packing overflows int32"
+    seqs_l = seqs.astype(jnp.int32)
+    wts_l = wts.astype(jnp.int32)
+
+    kern = functools.partial(
+        _kernel, v=v, lp=lp, d1=d1, p=p, s_=s_, a_=a_, k=k, wb=wb,
+        n_sl=n_sl, match=match, mismatch=mismatch, gap=gap,
+        wtype=wtype, trim=trim)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, d1, lp), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d1, lp), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d1, 8), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, v, 1), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, 1), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((v, p), jnp.int32),       # preds
+            pltpu.VMEM((v, p), jnp.int32),       # predw
+            pltpu.VMEM((v, s_), jnp.int32),      # succs
+            pltpu.VMEM((v, s_), jnp.int32),      # succw
+            pltpu.VMEM((v, s_), jnp.int32),      # succanch
+            pltpu.VMEM((v, a_), jnp.int32),      # aligned
+            pltpu.VMEM((v, wb), jnp.float32),    # ring (node-indexed)
+            pltpu.VMEM((v, wb), jnp.int32),      # dirs (node-indexed)
+            pltpu.VMEM((8, wb), jnp.float32),    # accs
+            pltpu.VMEM((8, wb), jnp.int32),      # arga
+            pltpu.VMEM((v + lp, 1), jnp.int32),  # packed path
+            pltpu.SMEM((v,), jnp.int32),         # base
+            pltpu.SMEM((v,), jnp.int32),         # anchor
+            pltpu.SMEM((v,), jnp.int32),         # nseqs
+            pltpu.SMEM((v,), jnp.int32),         # next
+            pltpu.SMEM((v,), jnp.int32),         # group-last
+            pltpu.SMEM((v,), jnp.int32),         # band (epoch<<8|sq)
+            pltpu.SMEM((v,), jnp.int32),         # pred count
+            pltpu.SMEM((v,), jnp.int32),         # succ count
+            pltpu.SMEM((4 * v,), jnp.int32),     # pred id mirror
+            pltpu.SMEM((v,), jnp.int32),         # order
+            pltpu.SMEM((v,), jnp.int32),         # sink-by-rank
+            pltpu.SMEM((v,), jnp.int32),         # consensus score
+            pltpu.SMEM((v,), jnp.int32),         # consensus pred
+            pltpu.SMEM((8,), jnp.int32),         # regs
+        ],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct((b, v, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((b, 8, 1), jnp.int32)),
+    )(nlay, bblen, seqs_l, wts_l, meta)
+
+
+def poa_full_batch(seqs, wts, meta, nlay, bblen, *,
+                   v, lp, d1, p=16, s=16, a=8, k=128, wb=256,
+                   match=5, mismatch=-4, gap=-8, wtype=1, trim=1):
+    """NumPy-facing wrapper.  Returns (cons_chars [B, V] int32 np,
+    mout [B, 8] int32 np).  mout rows: 0 length (-1 = failed ->
+    CPU re-polish), 1 status (2 = chimeric warning), 2 fail code,
+    3 nodes used, 4 total DP rank steps (for cells accounting)."""
+    cons, mout = _poa_full(
+        jnp.asarray(seqs), jnp.asarray(wts), jnp.asarray(meta),
+        jnp.asarray(nlay), jnp.asarray(bblen),
+        v, lp, d1, p, s, a, k, wb, match, mismatch, gap, wtype, trim)
+    return np.asarray(cons)[:, :, 0], np.asarray(mout)[:, :, 0]

@@ -1,0 +1,92 @@
+"""Full on-device POA kernel (racon_tpu/tpu/poa_pallas.py).
+
+On the CPU test platform the kernel runs in Pallas interpret mode on a
+tiny window (slow per-op, so the case is minimal); on a real TPU the
+compiled engine path is exercised end to end.  Consensus is compared
+against the native CPU engine within an edit tolerance — like the
+reference's CUDA-vs-CPU goldens, cost-equal alignment ties may resolve
+differently (test/racon_test.cpp:292-312 pins separate CUDA numbers
+for the same reason).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from racon_tpu.ops import cpu
+from tests.test_tpu_aligner import random_seq
+from tests.test_tpu_poa import cpu_consensus, make_window
+
+
+def _pack_one(w, d1, lp):
+    bb = w.sequences[0]
+    seqs = np.zeros((1, d1, lp), np.uint8)
+    wts = np.ones((1, d1, lp), np.uint8)
+    meta = np.zeros((1, d1, 8), np.int32)
+    seqs[0, 0, :len(bb)] = np.frombuffer(bb, np.uint8)
+    q0 = w.qualities[0]
+    if q0:
+        wts[0, 0, :len(bb)] = np.frombuffer(q0, np.uint8) - 33
+    offset = int(0.01 * len(bb))
+    idx = sorted(range(1, len(w.sequences)),
+                 key=lambda i: w.positions[i][0])
+    for d, li in enumerate(idx, start=1):
+        s = w.sequences[li]
+        seqs[0, d, :len(s)] = np.frombuffer(s, np.uint8)
+        ql = w.qualities[li]
+        if ql:
+            wts[0, d, :len(s)] = np.frombuffer(ql, np.uint8) - 33
+        begin, end = w.positions[li]
+        meta[0, d, :4] = (begin, end,
+                          1 if (begin < offset
+                                and end > len(bb) - offset) else 0,
+                          len(s))
+    return (seqs, wts, meta, np.array([len(idx)], np.int32),
+            np.array([len(bb)], np.int32))
+
+
+def test_full_device_kernel_interpret(monkeypatch):
+    """Tiny window through the kernel in interpret mode, checked
+    against the CPU engine."""
+    from jax.experimental import pallas as pl
+
+    from racon_tpu.tpu import poa_pallas
+
+    orig = pl.pallas_call
+
+    def interp(*a, **kw):
+        kw["interpret"] = True
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(poa_pallas.pl, "pallas_call", interp)
+
+    rng = random.Random(5)
+    truth = random_seq(60, rng)
+    w = make_window(truth, 3, 0.05, rng)
+    args = _pack_one(w, d1=4, lp=256)
+    cons, mout = poa_pallas.poa_full_batch(
+        *args, v=256, lp=256, d1=4, wb=256, wtype=1, trim=0)
+    length = int(mout[0, 0])
+    assert length > 0 and int(mout[0, 2]) == 0
+    out = bytes(cons[0, :length].astype(np.uint8))
+    ref = cpu_consensus(w, trim=False)
+    assert cpu.edit_distance(out, ref) <= max(2, len(truth) // 20)
+
+
+@pytest.mark.skipif(jax.devices()[0].platform != "tpu",
+                    reason="needs a real TPU backend")
+def test_full_device_engine_on_tpu():
+    from racon_tpu.tpu.poa import TPUPoaBatchEngine
+
+    rng = random.Random(21)
+    truth = random_seq(550, rng)
+    windows = [make_window(truth, 10, 0.1, rng) for _ in range(3)]
+    eng = TPUPoaBatchEngine(5, -4, -8, vcap=2048, pcap=16, lcap=1024)
+    results = eng.consensus_batch(windows, trim=True)
+    for w, (cons, ok) in zip(windows, results):
+        assert ok and cons is not None
+        assert cpu.edit_distance(cons, truth) <= max(
+            2, int(0.02 * len(truth)))
