@@ -91,8 +91,8 @@ def main():
         # compilation cache); the warm run is the steady-state number a
         # long polish sees -- the reference's CUDA kernels are compiled
         # at build time so its runs are always "warm"
-        cold_wall, _, _ = run_polish(tpu_poa_batches=1,
-                                     tpu_aligner_batches=1)
+        cold_wall, cold_out, _ = run_polish(tpu_poa_batches=1,
+                                            tpu_aligner_batches=1)
         log(f"[bench] TPU path (cold, incl. compiles): {cold_wall:.2f}s")
         accel_wall, accel_out, pol = run_polish(tpu_poa_batches=1,
                                                 tpu_aligner_batches=1)
@@ -108,8 +108,16 @@ def main():
             f"{align_cps / 1e9:.2f} Gcells/s (band cells)")
         log(f"[bench] stage device_poa: {poa_s:.2f}s, "
             f"{poa_cps / 1e9:.2f} Gcells/s (band cells)")
+        # run-to-run determinism: both TPU runs must emit identical
+        # bytes (the analog of the reference's byte-identical golden
+        # diff, ci/gpu/cuda_test.sh:33)
+        deterministic = len(cold_out) == len(accel_out) and all(
+            a.data == b.data for a, b in zip(cold_out, accel_out))
+        log(f"[bench] TPU path deterministic across runs: "
+            f"{deterministic}")
         extra = {
             "cold_wall_s": round(cold_wall, 3),
+            "deterministic": deterministic,
             "align_stage_s": round(align_s, 3),
             "poa_stage_s": round(poa_s, 3),
             "align_gcells_per_s": round(align_cps / 1e9, 3),
@@ -186,15 +194,20 @@ def scale_bench():
             return time.monotonic() - t0, out
 
         # TPU first: if the device path fails, bail before paying for
-        # the multi-minute CPU reference run
+        # the multi-minute CPU reference run.  Cold pays the scale
+        # shapes' one-time compiles; warm is the steady state (same
+        # methodology as the sample headline above).
+        scale_cold, _ = run(1, 1)
         tpu_wall, tpu_out = run(1, 1)
         d_tpu = cpu.edit_distance(tpu_out[0].data, truth)
         cpu_wall, cpu_out = run(0, 0)
         d_cpu = cpu.edit_distance(cpu_out[0].data, truth)
         log(f"[bench] scale (300kb, 15x synthetic): CPU {cpu_wall:.1f}s"
-            f" (dist {d_cpu}), TPU {tpu_wall:.1f}s (dist {d_tpu}), "
+            f" (dist {d_cpu}), TPU {tpu_wall:.1f}s warm / "
+            f"{scale_cold:.1f}s cold (dist {d_tpu}), "
             f"speedup {cpu_wall / tpu_wall:.2f}x")
         return {
+            "scale_tpu_cold_s": round(scale_cold, 3),
             "scale_cpu_wall_s": round(cpu_wall, 3),
             "scale_tpu_wall_s": round(tpu_wall, 3),
             "scale_speedup": round(cpu_wall / tpu_wall, 3),

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Build the native CPU engine library (the analog of the reference's
+# conda+cmake build, ci/common/build.sh); release flags by default,
+# `DEBUG=1` selects the AddressSanitizer configuration (the reference's
+# meson -Db_sanitize=address debug build).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+if [ "${DEBUG:-0}" = "1" ]; then
+    make -C racon_tpu/native debug -j
+else
+    make -C racon_tpu/native -j
+fi

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# CPU test pass (reference analog: ci/cpu/build.sh running ./racon_test
+# on the CPU): the full pytest matrix on the CPU backend with the
+# 8-device virtual mesh, including the e2e golden table.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+python -m pytest tests/ -q

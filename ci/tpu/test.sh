@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# TPU test pass (reference analog: ci/gpu/cuda_test.sh): polish the
+# sample dataset twice on the accelerated path and require (a) accuracy
+# within the latitude the reference grants its CUDA path and (b)
+# byte-identical stdout across runs -- the analog of the reference's
+# 2.6 MB golden FASTA diff (ci/gpu/cuda_test.sh:33).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+DATA=/root/reference/test/data
+ARGS="-t 8 -m 5 -x -4 -g -8 -c 1 --tpualigner-batches 1"
+python -m racon_tpu.cli $ARGS \
+    "$DATA/sample_reads.fastq.gz" "$DATA/sample_overlaps.paf.gz" \
+    "$DATA/sample_layout.fasta.gz" > /tmp/racon_tpu_ci_1.fasta
+python -m racon_tpu.cli $ARGS \
+    "$DATA/sample_reads.fastq.gz" "$DATA/sample_overlaps.paf.gz" \
+    "$DATA/sample_layout.fasta.gz" > /tmp/racon_tpu_ci_2.fasta
+cmp /tmp/racon_tpu_ci_1.fasta /tmp/racon_tpu_ci_2.fasta
+python - <<'PY'
+import gzip, sys
+sys.path.insert(0, ".")
+from racon_tpu.ops import cpu
+def fa(path, gz):
+    op = gzip.open if gz else open
+    out = []
+    with op(path, "rb") as fh:
+        for line in fh:
+            if not line.startswith(b">"):
+                out.append(line.strip())
+    return b"".join(out).upper()
+pol = fa("/tmp/racon_tpu_ci_1.fasta", False)
+ref = fa("/root/reference/test/data/sample_reference.fasta.gz", True)
+comp = bytes.maketrans(b"ACGT", b"TGCA")
+d = cpu.edit_distance(pol.translate(comp)[::-1], ref)
+print("tpu-path edit distance:", d)
+assert d <= 1450, d   # the latitude the reference's CUDA path gets
+PY
+echo "TPU CI PASS"

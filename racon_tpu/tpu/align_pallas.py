@@ -1,0 +1,340 @@
+"""Single-dispatch batched pairwise alignment: Pallas TPU kernel.
+
+Replaces the lax.scan wavefront kernels (racon_tpu/tpu/aligner.py) on
+real TPU backends.  The scan kernels pay per-step XLA overhead over
+``lq+lt`` anti-diagonals and one host round-trip per (bucket, chunk);
+on the tunneled-TPU deployment target those transfers cost ~100 ms of
+latency each.  This kernel aligns EVERY queued pair in one
+``pallas_call``: one grid program per pair runs a banded row-wise DP
+with the working set in VMEM and emits a compact 2-bit move tape.
+
+Design notes:
+
+* the row loop bound is each pair's REAL query length, so mixing
+  short and long pairs in one shape bucket costs only padding memory,
+  not padded compute — no per-length bucketing, no bucket dispatch
+  loop (the cudaaligner analog queues per-batch,
+  src/cuda/cudaaligner.cpp:52-86);
+* the band follows the proportional diagonal ``i*tl/ql``, quantized
+  to 128 columns so the per-row target slice and previous-row
+  realignment are lane-aligned (TPU dynamic lane offsets must be
+  128-multiples); an alignment of cost c deviates at most c columns
+  from that diagonal, so a tape whose cost fits the band margin is
+  exact (Ukkonen) and callers escalate the rest to a wider band;
+* no direction tape is materialised in HBM: the forward pass keeps
+  one score-row checkpoint every ``_CKPT`` rows in VMEM, and the
+  traceback re-derives each 128-row block's directions from its
+  checkpoint on demand (classic checkpointed traceback — ~2x compute
+  for ~lq*wb/4 bytes of saved HBM traffic per pair);
+* the kernel emits 2-bit moves (diag/up/left) packed 16-per-int32;
+  the host reconstructs =/X from the sequences vectorised, then RLEs
+  to a CIGAR (the reference also finishes CIGARs on the host,
+  src/cuda/cudaaligner.cpp:89-103).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BIG = 1 << 20
+_CKPT = 128                  # rows between score checkpoints
+_N_SHIFT = 3                 # band start advances <= 2 quanta per row
+_MV_DIAG, _MV_UP, _MV_LEFT, _MV_STOP = 0, 1, 2, 3
+
+
+def available() -> bool:
+    """Opt-in (RACON_TPU_PALLAS_ALIGN=1): on the current deployment
+    the measured per-row cost of the wide-band left-chain leaves this
+    kernel slower end-to-end than the hybrid scan-ladder + CPU-WFA
+    path, so the polisher defaults to that; the kernel is kept (and
+    tested) as the single-dispatch option for transfer-latency-bound
+    deployments with narrower bands."""
+    if os.environ.get("RACON_TPU_NO_PALLAS"):
+        return False
+    if not os.environ.get("RACON_TPU_PALLAS_ALIGN"):
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _kernel(ql_ref, tl_ref, q_ref, t_ref, tape_ref, dist_ref,
+            ckpt, dirs, regs_s, *,
+            lq: int, lt: int, wb: int):
+    i_prog = pl.program_id(0)
+    ql = ql_ref[i_prog]
+    tl = tl_ref[i_prog]
+    q = 128
+    nck = lq // _CKPT + 1
+    tape_w = (lq + lt) // 16 + 1
+    big = jnp.int32(_BIG)
+    cols = lax.broadcasted_iota(jnp.int32, (1, wb), 1)
+    iota_c = lax.broadcasted_iota(jnp.int32, (1, _CKPT), 1)
+    nq = jnp.maximum(ql, 1)
+    smax_q = (jnp.maximum(tl + 1 - wb, 0) + q - 1) // q
+
+    def sqq(i):
+        """Quantized band start for row i: centered on the
+        proportional diagonal (symmetric margins >= wb/2 - 128; paths
+        deviate either side, unlike the POA layer DP)."""
+        return jnp.clip(((i * tl) // nq - (wb // 2)) // q, 0, smax_q)
+
+    # t chars in u space: tb[c] = t[s + c] needs a 128-aligned slice,
+    # t_ref is padded by the wrapper so s + wb stays in range
+    def t_band(s):
+        return t_ref[0, :, pl.ds(pl.multiple_of(s, q), wb)]
+
+    def row_dp(i, pvp, qchars, i0):
+        """One DP row.  pvp: previous row D[i-1][s_{i-1} + c] padded
+        to wb + shift headroom.  Returns (row_u, dirs_row) where
+        row_u[c] = D[i][s_i + c]."""
+        sq_i = sqq(i)
+        s_i = sq_i * q
+        dq = sq_i - sqq(i - 1)
+        pu = pvp[:, 0:wb]
+        for mm in range(1, _N_SHIFT):
+            pu = jnp.where(dq == mm, pvp[:, mm * q: mm * q + wb], pu)
+        qc = jnp.sum(jnp.where(iota_c == (i - 1 - i0), qchars, 0))
+        tb = t_band(s_i)
+        j_u = s_i + cols                 # column of slot c, u space
+        sub_u = jnp.where(tb == qc, 0, 1)
+        # vert/diag in u space (diag shifts right once, post-min)
+        du = pu + sub_u
+        vu = pu + 1
+        t_u = jnp.minimum(jnp.pad(du, ((0, 0), (1, 0)),
+                                  constant_values=big)[:, :wb], vu)
+        # boundary column j == 0 (cell D[i][0] = i) and out-of-range
+        t_u2 = jnp.where(j_u == 0, i, t_u)
+        t_u2 = jnp.where(j_u > tl, big, t_u2)
+        # left chain: D[c] = min(T[c], D[c-1] + 1)
+        x = t_u2 - j_u
+        sh = 1
+        while sh < wb:
+            x = jnp.minimum(
+                x, jnp.pad(x, ((0, 0), (sh, 0)),
+                           constant_values=big)[:, :wb])
+            sh <<= 1
+        row = jnp.minimum(x + j_u, big)
+        dshift = jnp.pad(du, ((0, 0), (1, 0)),
+                         constant_values=big)[:, :wb]
+        dr = jnp.where(
+            row == dshift, _MV_DIAG,
+            jnp.where(row == vu, _MV_UP, _MV_LEFT)).astype(jnp.int32)
+        dr = jnp.where(j_u == 0, _MV_UP, dr)
+        return row, dr
+
+    def pad_row(row):
+        return jnp.pad(row, ((0, 0), (0, _N_SHIFT * q)),
+                       constant_values=big)
+
+    # ---- pass 1: forward scores, checkpoints every _CKPT rows -------
+    init = jnp.where(cols > tl, big, cols)       # D[0][j] = j, s_0 = 0
+    ckpt[0:1, :] = init
+
+    def blk_fwd(bk, pv):
+        i0 = bk * _CKPT
+        qchars = q_ref[0, :, pl.ds(pl.multiple_of(i0, _CKPT), _CKPT)]
+
+        def row_step(i, pv):
+            row, _ = row_dp(i, pv, qchars, i0)
+            return pad_row(row)
+
+        top = jnp.minimum((bk + 1) * _CKPT, ql)
+        pv = lax.fori_loop(i0 + 1, top + 1, row_step, pv)
+
+        @pl.when(top == (bk + 1) * _CKPT)
+        def _():
+            ckpt[pl.ds(bk + 1, 1), :] = pv[:, 0:wb]
+        return pv
+
+    nblk = (ql + _CKPT - 1) // _CKPT
+    pv = lax.fori_loop(0, nblk, blk_fwd, pad_row(init))
+
+    c_end = tl - sqq(ql) * q
+    dist = jnp.sum(jnp.where(cols == jnp.clip(c_end, 0, wb - 1),
+                             pv[:, 0:wb], 0))
+    dist = jnp.where((c_end < 0) | (c_end >= wb), big, dist)
+    dist_ref[0, 0:1, 0:1] = jnp.full((1, 1), dist, jnp.int32)
+
+    # ---- pass 2: checkpointed traceback -----------------------------
+    tape_ref[0, :, :] = jnp.zeros((tape_w, 1), jnp.int32)
+    # regs: 0 cur word, 1 word count, 2 bit count, 3 i, 4 j
+    regs_s[0] = jnp.int32(0)
+    regs_s[1] = jnp.int32(0)
+    regs_s[2] = jnp.int32(0)
+    regs_s[3] = ql
+    regs_s[4] = tl
+
+    def emit(mv):
+        w = regs_s[0] | (mv << (regs_s[2] * 2))
+        nb = regs_s[2] + 1
+        full = nb == 16
+
+        @pl.when(full)
+        def _():
+            tape_ref[0, pl.ds(regs_s[1], 1), 0:1] = jnp.full(
+                (1, 1), w, jnp.int32)
+            regs_s[0] = jnp.int32(0)
+            regs_s[1] = regs_s[1] + 1
+            regs_s[2] = jnp.int32(0)
+
+        @pl.when(jnp.logical_not(full))
+        def _():
+            regs_s[0] = w
+            regs_s[2] = nb
+
+    def blk_bwd(bkr, _):
+        bk = nblk - 1 - bkr
+        i0 = bk * _CKPT
+
+        @pl.when(regs_s[3] > i0)
+        def _():
+            # rebuild this block's direction rows from its checkpoint
+            qchars = q_ref[0, :, pl.ds(pl.multiple_of(i0, _CKPT), _CKPT)]
+
+            def row_step(i, pv):
+                row, dr = row_dp(i, pv, qchars, i0)
+                dirs[pl.ds(i - 1 - i0, 1), :] = dr
+                return pad_row(row)
+
+            top = jnp.minimum(i0 + _CKPT, ql)
+            pv0 = pad_row(ckpt[pl.ds(bk, 1), :])
+            lax.fori_loop(i0 + 1, top + 1, row_step, pv0)
+
+            # walk while inside this block
+            def w_cond2(c):
+                i = c[0]
+                j = c[1]
+                return (i > i0) | ((i0 == 0) & ((i > 0) | (j > 0)))
+
+            def w_body(c):
+                i, j = c
+
+                @pl.when(i == 0)
+                def _():
+                    emit(jnp.int32(_MV_LEFT))
+
+                @pl.when(i > 0)
+                def _():
+                    s_i = sqq(i) * q
+                    cc = jnp.clip(j - s_i, 0, wb - 1)
+                    drow = dirs[pl.ds(i - 1 - i0, 1), :]
+                    mv = jnp.sum(jnp.where(cols == cc, drow, 0))
+                    mv = jnp.where(j <= 0, _MV_UP, mv)
+                    emit(mv)
+                    regs_s[3] = jnp.where(mv != _MV_LEFT, i - 1, i)
+                    regs_s[4] = jnp.where(mv != _MV_UP, j - 1, j)
+
+                ni = jnp.where(i == 0, i, regs_s[3])
+                nj = jnp.where(i == 0, j - 1, regs_s[4])
+                regs_s[3] = ni
+                regs_s[4] = nj
+                return ni, nj
+
+            ii, jj = lax.while_loop(w_cond2, w_body,
+                                    (regs_s[3], regs_s[4]))
+            regs_s[3] = ii
+            regs_s[4] = jj
+        return 0
+
+    lax.fori_loop(0, nblk, blk_bwd, 0)
+    # flush the partial word + record the tape length
+    @pl.when(regs_s[2] > 0)
+    def _():
+        tape_ref[0, pl.ds(regs_s[1], 1), 0:1] = jnp.full(
+            (1, 1), regs_s[0], jnp.int32)
+        regs_s[1] = regs_s[1] + 1
+    dist_ref[0, 1:2, 0:1] = jnp.full(
+        (1, 1), regs_s[1] * 16 - jnp.where(regs_s[2] > 0,
+                                           16 - regs_s[2], 0),
+        jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6))
+def _align(q, t, ql, tl, lq: int, lt: int, wb: int):
+    b = q.shape[0]
+    tape_w = (lq + lt) // 16 + 1
+    q_i = q.astype(jnp.int32)[:, None, :]
+    t_i = jnp.pad(t.astype(jnp.int32), ((0, 0), (0, wb + 128)),
+                  constant_values=-1)[:, None, :]
+    kern = functools.partial(_kernel, lq=lq, lt=lt, wb=wb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, 1, lq), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, lt + wb + 128), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, tape_w, 1), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, 1), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((lq // _CKPT + 1, wb), jnp.int32),   # ckpt
+            pltpu.VMEM((_CKPT, wb), jnp.int32),             # dirs
+            pltpu.SMEM((8,), jnp.int32),                    # regs
+        ],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct((b, tape_w, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((b, 8, 1), jnp.int32)),
+    )(ql, tl, q_i, t_i)
+
+
+def align_batch(queries, targets, lq: int, lt: int, wb: int):
+    """Align padded pair batches; returns (moves, lens, dists).
+
+    moves: [B, n] uint8 of 2-bit codes in traceback (reversed) order,
+    lens: [B] number of valid moves, dists: [B] band edit distance
+    (_BIG when the endpoint fell outside the band).
+    """
+    from racon_tpu.tpu.aligner import encode_batch, _QPAD, _TPAD
+
+    q = encode_batch(queries, lq, _QPAD)
+    t = encode_batch(targets, lt, _TPAD)
+    ql = np.array([len(s) for s in queries], np.int32)
+    tl = np.array([len(s) for s in targets], np.int32)
+    tape, meta = _align(q, t, ql, tl, lq, lt, wb)
+    tape = np.asarray(tape)[:, :, 0].astype(np.uint32)
+    meta = np.asarray(meta)[:, :, 0]
+    n = tape.shape[1] * 16
+    moves = np.zeros((tape.shape[0], n), np.uint8)
+    for sh in range(16):
+        moves[:, sh::16] = (tape >> (2 * sh)) & 3
+    return moves, meta[:, 1], meta[:, 0]
+
+
+def moves_to_ops(moves_row, length, query: bytes, target: bytes):
+    """Decode one reversed 2-bit move row into the aligner op alphabet
+    (=/X/I/D codes from racon_tpu.tpu.aligner), vectorised."""
+    from racon_tpu.tpu import aligner as al
+
+    mv = moves_row[:length][::-1]                  # forward order
+    di = (mv != _MV_LEFT).astype(np.int64)
+    dj = (mv != _MV_UP).astype(np.int64)
+    i_idx = np.cumsum(di) - 1                      # query index used
+    j_idx = np.cumsum(dj) - 1
+    qa = np.frombuffer(query, np.uint8)
+    ta = np.frombuffer(target, np.uint8)
+    eq = np.zeros(len(mv), bool)
+    m = mv == _MV_DIAG
+    eq[m] = qa[i_idx[m]] == ta[j_idx[m]]
+    ops = np.where(m, np.where(eq, al.OP_EQ, al.OP_X),
+                   np.where(mv == _MV_UP, al.OP_I, al.OP_D))
+    return ops.astype(np.uint8)[::-1]              # reversed, like scan

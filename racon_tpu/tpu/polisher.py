@@ -32,6 +32,18 @@ def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, default))
 
 
+def _split_cut(weights, share: float) -> int:
+    """Deterministic hybrid boundary: first index where the weight
+    prefix reaches ``share`` of the total (device owns [0, cut))."""
+    total = sum(weights) or 1
+    acc = 0
+    for k, w in enumerate(weights):
+        if acc >= share * total:
+            return k
+        acc += w
+    return len(weights)
+
+
 class TPUPolisher(Polisher):
     # absolute per-alignment dimension cap; larger pairs go to the CPU
     # aligner (the reference's exceeded_max_length contract,
@@ -133,6 +145,10 @@ class TPUPolisher(Polisher):
         vcap, lcap = self._poa_caps()
         n_dev = len(self.mesh.devices)
         batch_size = self._poa_batch_size(vcap, lcap, n_dev)
+        # the full-device engine uploads B x depth x lcap bytes per
+        # megabatch; cap B so one upload stays ~10 MB on big runs
+        batch_size = min(batch_size,
+                         _env_int("RACON_TPU_POA_MEGABATCH", 256))
         # -b narrows the POA band (cudapoa banded analog); default is
         # the auto band (l_b/4, floor 256)
         engine = TPUPoaBatchEngine(
@@ -152,10 +168,61 @@ class TPUPolisher(Polisher):
                 w.consensus = w.sequences[0]
         eligible.sort(key=lambda i: -len(self.windows[i].sequences))
 
+        # hybrid execution: the host cores are an engine too, running
+        # the native POA CONCURRENTLY with the device megabatches --
+        # the heterogeneous analog of the reference's per-GPU shared
+        # batch queue (src/cuda/cudapolisher.cpp:257-336).  Two
+        # scheduling modes:
+        #   * default: a DETERMINISTIC static split at a cost-model
+        #     boundary (depth^2 ~ graph size x layers), so repeated
+        #     runs emit byte-identical output (the two engines resolve
+        #     cost-ties differently, so assignment must not depend on
+        #     timing);
+        #   * RACON_TPU_STEAL=1: self-balancing work stealing (device
+        #     pops deep windows, CPU workers steal shallow ones) --
+        #     faster when the engines' relative rates are unknown, at
+        #     the price of run-to-run output variation.
+        import threading
+        from collections import deque
+
+        lock = threading.Lock()
+        n_workers = max(1, self._pool._max_workers - 1)
+        if os.environ.get("RACON_TPU_POA_DEVICE_ONLY"):
+            n_workers = 0
+        steal = bool(os.environ.get("RACON_TPU_STEAL")) and n_workers
+        work = deque(eligible)
+        if steal or not n_workers:
+            dev_left = len(eligible)     # device may reach everything
+        else:
+            dev_left = _split_cut(
+                [len(self.windows[i].sequences) ** 2
+                 for i in eligible],
+                float(os.environ.get("RACON_TPU_POA_SPLIT", "0.45")))
+
+        def cpu_worker():
+            while True:
+                with lock:
+                    if len(work) <= (0 if steal else dev_left):
+                        return
+                    i = work.pop()
+                flags[i] = self.windows[i].generate_consensus(
+                    self.engine, self.trim)
+
+        workers = [self._pool.submit(cpu_worker)
+                   for _ in range(n_workers)]
+
         failed: List[int] = []
-        n_done = 0
-        for k in range(0, len(eligible), batch_size):
-            idxs = eligible[k:k + batch_size]
+        while True:
+            with lock:
+                limit = len(work) if steal else min(len(work),
+                                                    dev_left)
+                take = min(batch_size, limit)
+                if steal:
+                    take = min(take, max(16, (limit + 1) // 2))
+                idxs = [work.popleft() for _ in range(take)]
+                dev_left -= take
+            if not idxs:
+                break
             batch = [self.windows[i] for i in idxs]
             results = engine.consensus_batch(batch, self.trim,
                                              pool=self._pool)
@@ -165,9 +232,10 @@ class TPUPolisher(Polisher):
                 else:
                     self.windows[i].consensus = cons
                     flags[i] = ok
-            n_done += len(idxs)
             self.logger.bar("[racon_tpu::TPUPolisher::polish] generating"
                             " consensus (device)")
+        for fut in workers:
+            fut.result()
 
         # CPU re-polish of device-rejected windows
         # (reference: src/cuda/cudapolisher.cpp:357-386)
@@ -236,31 +304,152 @@ class TPUPolisher(Polisher):
         if not pending:
             return
 
-        # group by bucket shape, then chunk by the memory budget:
-        # packed direction tape is (lq+lt) * ceil((lt+1)/4) bytes/lane
-        pending.sort(key=lambda x: (x[0], x[1]))
+        # hybrid work-stealing, like the POA stage: the device consumes
+        # same-bucket runs from the large end of the queue while CPU
+        # WFA workers steal small overlaps from the other end (device
+        # dispatches release the GIL while blocking).  A stolen overlap
+        # gets the full base-class treatment (CIGAR + breaking points),
+        # so the fall-through pass skips it.
+        import threading
+        from collections import deque
+
+        from racon_tpu.ops import cpu as cpu_ops
+
+        pending.sort(key=lambda x: -x[0])
+
+        from racon_tpu.tpu import align_pallas
+        if align_pallas.available():
+            cut = _split_cut(
+                [p[0] for p in pending],
+                float(os.environ.get("RACON_TPU_ALIGN_SPLIT", "0.5")))
+            cpu_share = [o for _, _, o in pending[cut:]]
+            futures = [self._pool.submit(
+                lambda o: o.find_breaking_points(
+                    self.sequences, self.window_length,
+                    aligner=cpu_ops.align), o) for o in cpu_share]
+            if cut:
+                self._pallas_align([o for _, _, o in pending[:cut]])
+            for f in futures:
+                f.result()
+            return
+
+        n_workers = max(1, self._pool._max_workers - 1)
+        if os.environ.get("RACON_TPU_ALIGN_DEVICE_ONLY"):
+            n_workers = 0
+        steal = bool(os.environ.get("RACON_TPU_STEAL")) and n_workers
+        work = deque(pending)
+        if steal or not n_workers:
+            dev_left = len(pending)
+        else:
+            # deterministic static boundary (see the POA stage): the
+            # CPU owns the small-bucket tail past the cut
+            dev_left = _split_cut(
+                [p[0] for p in pending],
+                float(os.environ.get("RACON_TPU_ALIGN_SPLIT", "0.5")))
+        lock = threading.Lock()
+        n_cpu_done = 0
+
+        def cpu_worker():
+            nonlocal n_cpu_done
+            while True:
+                with lock:
+                    if len(work) <= (0 if steal else dev_left):
+                        return
+                    _, _, o = work.pop()
+                    n_cpu_done += 1
+                o.find_breaking_points(self.sequences,
+                                       self.window_length,
+                                       aligner=cpu_ops.align)
+
+        workers = [self._pool.submit(cpu_worker)
+                   for _ in range(n_workers)]
+
         n_dev = len(self.mesh.devices)
         n_done = 0
-        i = 0
-        while i < len(pending):
-            blq, blt, _ = pending[i]
-            j = i
-            while j < len(pending) and pending[j][:2] == (blq, blt):
-                j += 1
-            # banded ladder: most lanes finish at hw<=2048, so budget
-            # on that rung's packed-tape footprint
-            bytes_per_lane = (blq + blt) * ((min(2048, blt) + 5) // 4)
-            max_b = max(n_dev, int(self.align_mem_budget // bytes_per_lane))
-            max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
-            for k in range(i, j, max_b):
-                chunk = [o for _, _, o in pending[k:min(k + max_b, j)]]
-                self._align_chunk(chunk, blq, blt, n_dev)
-                n_done += len(chunk)
-                self.logger.log(
-                    f"[racon_tpu::TPUPolisher::align] device-aligned "
-                    f"{n_done}/{len(pending)} overlaps "
-                    f"(bucket {blq}x{blt})")
-            i = j
+        while True:
+            with lock:
+                limit = len(work) if steal else min(len(work),
+                                                    dev_left)
+                if limit <= 0:
+                    break
+                blq, blt, _ = work[0]
+                bytes_per_lane = (blq + blt) * \
+                    ((min(2048, blt) + 5) // 4)
+                max_b = max(n_dev, int(self.align_mem_budget
+                                       // bytes_per_lane))
+                max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
+                if steal:
+                    max_b = min(max_b, max(8, (limit + 1) // 2))
+                chunk = []
+                while work and len(chunk) < min(max_b, limit) \
+                        and work[0][:2] == (blq, blt):
+                    chunk.append(work.popleft()[2])
+                dev_left -= len(chunk)
+            self._align_chunk(chunk, blq, blt, n_dev)
+            n_done += len(chunk)
+            self.logger.log(
+                f"[racon_tpu::TPUPolisher::align] device-aligned "
+                f"{n_done} overlaps (bucket {blq}x{blt})")
+        for f in workers:
+            f.result()
+        if n_cpu_done:
+            self.logger.log(
+                f"[racon_tpu::TPUPolisher::align] cpu-aligned "
+                f"{n_cpu_done} overlaps concurrently")
+
+    def _pallas_align(self, overlaps: List[Overlap]) -> None:
+        """Single-dispatch device alignment (align_pallas kernel): all
+        pairs in ONE shape bucket (dynamic row loops make padding
+        free), with a two-rung band escalation; pairs the widest band
+        cannot certify are left to the CPU fall-through (the
+        reference's exceeded_max_alignment_difference contract,
+        src/cuda/cudaaligner.cpp:64-72)."""
+        from racon_tpu.tpu import align_pallas, aligner
+
+        queries = [o.query_span(self.sequences) for o in overlaps]
+        targets = [o.target_span(self.sequences) for o in overlaps]
+        dim = max(max(len(s) for s in queries),
+                  max(len(s) for s in targets))
+        bd = min((dim + 127) // 128 * 128, self.max_align_dim)
+        # per-pair starting rung from the expected cost (length
+        # difference, ~20% ONT divergence), like the scan ladder --
+        # running a guaranteed-to-fail narrow band doubles the work
+        # Ukkonen certificate for the proportional-diagonal band: a
+        # path of cost c deviates at most (c + |dlen|) / 2 columns
+        # from the diagonal, so a band of wb columns (quantized 128,
+        # margin wb/2 - 256 per side) certifies
+        # cost + |dlen| <= wb - 512.
+        dabs = [abs(len(q) - len(t))
+                for q, t in zip(queries, targets)]
+        need = [max(dabs[i], max(len(q), len(t)) // 5)
+                for i, (q, t) in enumerate(zip(queries, targets))]
+        pending = list(range(len(overlaps)))
+        for wb in (1024, 2048, 4096, 8192):
+            if not pending or wb - 512 > 2 * bd:
+                break
+            idx = [i for i in pending
+                   if need[i] + dabs[i] <= wb - 512 or wb == 8192]
+            if not idx:
+                continue
+            moves, lens, dists = align_pallas.align_batch(
+                [queries[i] for i in idx], [targets[i] for i in idx],
+                bd, bd, wb)
+            self.align_cells += sum(len(queries[i]) for i in idx) * wb
+            still = set()
+            for k, i in enumerate(idx):
+                if dists[k] + dabs[i] <= wb - 512:
+                    ops = align_pallas.moves_to_ops(
+                        moves[k], int(lens[k]), queries[i], targets[i])
+                    overlaps[i].cigar = aligner.ops_to_cigar(ops)
+                else:
+                    still.add(i)
+            idx_set = set(idx)
+            pending = [i for i in pending
+                       if i in still or i not in idx_set]
+            self.logger.log(
+                f"[racon_tpu::TPUPolisher::align] device-aligned "
+                f"{len(idx) - len(still)}/{len(idx)} overlaps "
+                f"(band {wb})")
 
     def _align_chunk(self, chunk: List[Overlap], blq: int, blt: int,
                      n_dev: int) -> None:

@@ -1,0 +1,69 @@
+"""Single-dispatch Pallas aligner (racon_tpu/tpu/align_pallas.py).
+
+Interpret mode on the CPU test platform (tiny pair), compiled on a
+real TPU.  The banded distance must equal the exact edit distance
+whenever the band certificate holds, and the decoded CIGAR must
+consume both sequences at that cost.
+"""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+import jax
+
+from racon_tpu.ops import cpu
+from racon_tpu.tpu import aligner as al
+from tests.test_tpu_aligner import mutate, random_seq
+
+
+def _check_pair(q, t, moves_row, length, dist):
+    want = cpu.edit_distance(q, t)
+    assert dist == want
+    ops = __import__("racon_tpu.tpu.align_pallas",
+                     fromlist=["moves_to_ops"]).moves_to_ops(
+        moves_row, length, q, t)
+    cig = al.ops_to_cigar(ops)
+    runs = re.findall(r"(\d+)([=XID])", cig)
+    qi = sum(int(x) for x, o in runs if o in "=XI")
+    ti = sum(int(x) for x, o in runs if o in "=XD")
+    cost = sum(int(x) for x, o in runs if o != "=")
+    assert (qi, ti, cost) == (len(q), len(t), want)
+
+
+def test_align_pallas_interpret(monkeypatch):
+    from jax.experimental import pallas as pl
+
+    from racon_tpu.tpu import align_pallas as ap
+
+    orig = pl.pallas_call
+
+    def interp(*a, **kw):
+        kw["interpret"] = True
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(ap.pl, "pallas_call", interp)
+
+    rng = random.Random(9)
+    q = random_seq(300, rng)
+    t = mutate(q, 0.08, rng)
+    moves, lens, dists = ap.align_batch([q], [t], 512, 512, 512)
+    _check_pair(q, t, moves[0], int(lens[0]), int(dists[0]))
+
+
+@pytest.mark.skipif(jax.devices()[0].platform != "tpu",
+                    reason="needs a real TPU backend")
+def test_align_pallas_on_tpu():
+    from racon_tpu.tpu import align_pallas as ap
+
+    rng = random.Random(3)
+    pairs = [(random_seq(n, rng),) for n in (900, 3000, 1200)]
+    pairs = [(q[0], mutate(q[0], r, rng))
+             for q, r in zip(pairs, (0.05, 0.12, 0.02))]
+    qs = [p[0] for p in pairs]
+    ts = [p[1] for p in pairs]
+    moves, lens, dists = ap.align_batch(qs, ts, 4096, 4096, 2048)
+    for i, (q, t) in enumerate(pairs):
+        _check_pair(q, t, moves[i], int(lens[i]), int(dists[i]))

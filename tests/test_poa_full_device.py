@@ -90,3 +90,40 @@ def test_full_device_engine_on_tpu():
         assert ok and cons is not None
         assert cpu.edit_distance(cons, truth) <= max(
             2, int(0.02 * len(truth)))
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(jax.devices()[0].platform != "tpu",
+                    reason="needs a real TPU backend")
+def test_tpu_e2e_sample_golden(reference_data):
+    """Pinned TPU-path e2e golden on the reference sample: accuracy
+    within the latitude the reference grants its CUDA path
+    (test/racon_test.cpp:312 allows 1385 vs the CPU's 1312), zero
+    device rejections, deterministic across runs."""
+    import gzip
+    import os
+
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+
+    def run():
+        pol = create_polisher(
+            os.path.join(reference_data, "sample_reads.fastq.gz"),
+            os.path.join(reference_data, "sample_overlaps.paf.gz"),
+            os.path.join(reference_data, "sample_layout.fasta.gz"),
+            PolisherType.kC, 500, 10.0, 0.3, True, 5, -4, -8,
+            num_threads=8, tpu_poa_batches=1, tpu_aligner_batches=1)
+        pol.initialize()
+        out = pol.polish(True)
+        return out, pol
+
+    out1, pol = run()
+    assert sum(pol.poa_reject_counts.values()) == 0
+    with gzip.open(os.path.join(reference_data,
+                                "sample_reference.fasta.gz"), "rb") as fh:
+        ref = b"".join(l.strip() for l in fh
+                       if not l.startswith(b">")).upper()
+    comp = bytes.maketrans(b"ACGT", b"TGCA")
+    rc = out1[0].data.translate(comp)[::-1]
+    assert cpu.edit_distance(rc, ref) <= 1450
+    out2, _ = run()
+    assert out1[0].data == out2[0].data
