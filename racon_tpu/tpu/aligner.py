@@ -322,7 +322,7 @@ def band_align_batch(queries: Sequence[bytes], targets: Sequence[bytes],
                 return _banded_align_kernel(q, t, ql, tl, lq, lt, hw)
             return _align_kernel(q, t, ql, tl, lq, lt)
 
-    def run(idx, hw):
+    def run_one(idx, hw):
         nonlocal cells
         bb = _pow2_batch(len(idx))
         qs = [queries[i] for i in idx]
@@ -336,6 +336,18 @@ def band_align_batch(queries: Sequence[bytes], targets: Sequence[bytes],
         ops = np.asarray(dispatch(q, t, ql, tl, blq, blt, hw))
         cells += bb * (blq + blt) * ((hw + 2) if hw else (blt + 1))
         return ops[:len(idx)]
+
+    def run(idx, hw):
+        # chunk by THIS rung's direction-tape footprint: a wide rung
+        # (8192) costs ~16x the narrow one per lane, so a fixed lane
+        # count would exhaust HBM on divergent workloads
+        width = (hw + 5) // 4 if hw else (blt + 4) // 4
+        per_lane = (blq + blt) * width
+        cap = max(8, int(mem_budget // per_lane))
+        cap = 1 << (cap.bit_length() - 1)   # pow2: padding respects it
+        outs = [run_one(idx[k:k + cap], hw)
+                for k in range(0, len(idx), cap)]
+        return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
     pending = np.arange(n)
     for hw in BAND_LADDER:
@@ -359,11 +371,8 @@ def band_align_batch(queries: Sequence[bytes], targets: Sequence[bytes],
     # caller's band-sized chunking.
     if len(pending) and (allow_full
                          or max(blq, blt) <= max(BAND_LADDER)):
-        full_bytes = (blq + blt) * ((blt + 4) // 4)
-        step = max(1, int(mem_budget // full_bytes))
-        for k in range(0, len(pending), step):
-            part = pending[k:k + step]
-            ops_out[part] = run(part, 0)
+        # run() self-chunks by the full kernel's tape footprint
+        ops_out[pending] = run(pending, 0)
         pending = pending[:0]
     return ops_out, cells, pending
 

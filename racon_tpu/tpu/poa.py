@@ -436,20 +436,23 @@ class TPUPoaBatchEngine:
             if poa_pallas.available():
                 # the kernel's window type is a compile-time constant;
                 # split mixed batches so each window trims per its own
-                # type (parity with the per-window lockstep/CPU paths)
+                # type (parity with the per-window lockstep/CPU paths).
+                # _run_full_device returns None when the configuration
+                # exceeds the kernel's VMEM budget -> lockstep below.
                 types = {w.type.value for w in windows}
-                if len(types) <= 1:
-                    return self._run_full_device(windows, trim)
-                results: List[Tuple[Optional[bytes], bool]] = \
-                    [None] * len(windows)
-                for tv in sorted(types):
-                    idxs = [i for i, w in enumerate(windows)
-                            if w.type.value == tv]
-                    sub = self._run_full_device(
-                        [windows[i] for i in idxs], trim)
-                    for i, r in zip(idxs, sub):
-                        results[i] = r
-                return results
+                if self._fits_full_device(windows):
+                    if len(types) <= 1:
+                        return self._run_full_device(windows, trim)
+                    results: List[Tuple[Optional[bytes], bool]] = \
+                        [None] * len(windows)
+                    for tv in sorted(types):
+                        idxs = [i for i, w in enumerate(windows)
+                                if w.type.value == tv]
+                        sub = self._run_full_device(
+                            [windows[i] for i in idxs], trim)
+                        for i, r in zip(idxs, sub):
+                            results[i] = r
+                    return results
         n = len(windows)
         nb = _NativeBatch(n)
         try:
@@ -458,6 +461,21 @@ class TPUPoaBatchEngine:
             nb.close()
 
     # -- full on-device path (flagship Pallas kernel) ------------------
+
+    def _fits_full_device(self, windows) -> bool:
+        """Side-effect-free VMEM precheck (d1 from raw layer counts,
+        an upper bound on what _order_layers keeps)."""
+        from racon_tpu.tpu import poa_pallas
+        from racon_tpu.utils.tuning import pow2_at_least
+
+        lp = self.lcap
+        wb = max(256, ((self.band_cols or lp // 4) + 127) & ~127)
+        wb = min(wb, ((lp + 127) & ~127))
+        depth = max((min(len(w.sequences) - 1, self.max_depth)
+                     for w in windows), default=0)
+        d1 = max(8, pow2_at_least(depth + 1, 8))
+        return poa_pallas.fits(self.vcap, lp, d1, self.pcap,
+                               self.pcap, 8, wb)
 
     def _order_layers(self, w):
         idx = sorted(range(1, len(w.sequences)),
@@ -469,6 +487,7 @@ class TPUPoaBatchEngine:
 
     def _run_full_device(self, windows, trim) \
             -> List[Tuple[Optional[bytes], bool]]:
+        """Callers must have passed _fits_full_device first."""
         from racon_tpu.tpu import poa_pallas
         from racon_tpu.utils.tuning import pow2_at_least
 

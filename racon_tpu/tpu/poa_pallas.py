@@ -79,6 +79,20 @@ def available() -> bool:
         return False
 
 
+def fits(v: int, lp: int, d1: int, p: int, s: int, a: int,
+         wb: int) -> bool:
+    """Conservative per-program VMEM estimate: ring + dirs (v x wb),
+    adjacency, lane-padded path/output refs, double-buffered input
+    blocks.  Configurations over budget (e.g. -w 1000 doubles every
+    cap) use the lockstep engine instead of failing to compile."""
+    bytes_ = (v * wb * 8                      # ring f32 + dirs i32
+              + v * (2 * p + 3 * s + a) * 4   # adjacency
+              + (v + lp) * 128 * 4            # packed path (lane pad)
+              + 2 * 2 * d1 * lp * 4           # seq/wts blocks x2 buf
+              + 2 * v * 128 * 4)              # cons out x2 buf
+    return bytes_ <= (13 << 20)
+
+
 def _kernel(nlay_ref, bblen_ref,
             seqs_ref, wts_ref, meta_ref,
             cons_ref, mout_ref,
