@@ -82,6 +82,11 @@ def main():
     log(f"[bench] jax devices: {jax.devices()}")
 
     cpu_wall, cpu_out, _ = run_polish()
+    # same sampling as the accelerated path (min of two) so run noise
+    # doesn't bias vs_baseline either way
+    cpu_wall2, cpu_out2, _ = run_polish()
+    if cpu_wall2 < cpu_wall:
+        cpu_wall, cpu_out = cpu_wall2, cpu_out2
     cpu_dist = accuracy(cpu_out)
     log(f"[bench] CPU path: {cpu_wall:.2f}s, edit distance {cpu_dist} "
         "(reference CPU golden 1312, test/racon_test.cpp:107)")
@@ -96,6 +101,13 @@ def main():
         log(f"[bench] TPU path (cold, incl. compiles): {cold_wall:.2f}s")
         accel_wall, accel_out, pol = run_polish(tpu_poa_batches=1,
                                                 tpu_aligner_batches=1)
+        # second warm sample: the tunneled host shows +-20% run noise,
+        # so the headline takes the faster of two steady-state runs
+        accel_wall2, accel_out2, pol2 = run_polish(
+            tpu_poa_batches=1, tpu_aligner_batches=1)
+        if accel_wall2 < accel_wall:
+            accel_wall, accel_out, pol = (accel_wall2, accel_out2,
+                                          pol2)
         accel_dist = accuracy(accel_out)
         align_s = pol.stage_walls.get("device_align", 0.0)
         poa_s = pol.stage_walls.get("device_poa", 0.0)
@@ -111,8 +123,10 @@ def main():
         # run-to-run determinism: both TPU runs must emit identical
         # bytes (the analog of the reference's byte-identical golden
         # diff, ci/gpu/cuda_test.sh:33)
-        deterministic = len(cold_out) == len(accel_out) and all(
-            a.data == b.data for a, b in zip(cold_out, accel_out))
+        deterministic = all(
+            len(cold_out) == len(o) and all(
+                a.data == b.data for a, b in zip(cold_out, o))
+            for o in (accel_out, accel_out2))
         log(f"[bench] TPU path deterministic across runs: "
             f"{deterministic}")
         extra = {

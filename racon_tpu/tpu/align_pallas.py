@@ -5,27 +5,32 @@ real TPU backends.  The scan kernels pay per-step XLA overhead over
 ``lq+lt`` anti-diagonals and one host round-trip per (bucket, chunk);
 on the tunneled-TPU deployment target those transfers cost ~100 ms of
 latency each.  This kernel aligns EVERY queued pair in one
-``pallas_call``: one grid program per pair runs a banded row-wise DP
-with the working set in VMEM and emits a compact 2-bit move tape.
+``pallas_call`` and emits a compact 2-bit move tape.
 
 Design notes:
 
-* the row loop bound is each pair's REAL query length, so mixing
-  short and long pairs in one shape bucket costs only padding memory,
-  not padded compute — no per-length bucketing, no bucket dispatch
-  loop (the cudaaligner analog queues per-batch,
-  src/cuda/cudaaligner.cpp:52-86);
-* the band follows the proportional diagonal ``i*tl/ql``, quantized
-  to 128 columns so the per-row target slice and previous-row
-  realignment are lane-aligned (TPU dynamic lane offsets must be
-  128-multiples); an alignment of cost c deviates at most c columns
-  from that diagonal, so a tape whose cost fits the band margin is
-  exact (Ukkonen) and callers escalate the rest to a wider band;
+* **4 pairs per grid program, stacked on the sublane axis**: the
+  banded row DP's critical path is the in-row prefix-min chain
+  (log2(wb) serial vector steps, latency-bound regardless of width),
+  so four independent pairs share ONE chain per row group -- ~3x the
+  single-pair throughput.  Callers sort pairs by length so group
+  partners finish together;
+* the row loop bound is the group's longest REAL query, so mixing
+  short and long pairs in one shape bucket costs padding memory, not
+  padded compute -- no per-length bucket dispatch loop (the
+  cudaaligner analog queues per-batch, src/cuda/cudaaligner.cpp:52-86);
+* the band follows each pair's proportional diagonal ``i*tl/ql``,
+  quantized to 128 columns so the per-row target slice and the
+  previous-row realignment are lane-aligned (TPU dynamic lane offsets
+  must be 128-multiples); an alignment of cost c deviates at most
+  ``(c + |tl-ql|)/2`` columns from that diagonal, so a tape satisfying
+  ``cost + |tl-ql| <= wb - 512`` is exact (Ukkonen) and callers
+  escalate the rest to a wider band;
 * no direction tape is materialised in HBM: the forward pass keeps
   one score-row checkpoint every ``_CKPT`` rows in VMEM, and the
   traceback re-derives each 128-row block's directions from its
-  checkpoint on demand (classic checkpointed traceback — ~2x compute
-  for ~lq*wb/4 bytes of saved HBM traffic per pair);
+  checkpoint on demand, walking all four pairs' segments through a
+  block before moving down (one recompute per block, not per pair);
 * the kernel emits 2-bit moves (diag/up/left) packed 16-per-int32;
   the host reconstructs =/X from the sequences vectorised, then RLEs
   to a CIGAR (the reference also finishes CIGARs on the host,
@@ -47,20 +52,24 @@ from jax.experimental.pallas import tpu as pltpu
 
 _BIG = 1 << 20
 _CKPT = 128                  # rows between score checkpoints
+                             # (halved for wide bands: VMEM dirs block)
+
+
+def _ckrows(wb: int) -> int:
+    return 64 if wb >= 4096 else _CKPT
 _N_SHIFT = 3                 # band start advances <= 2 quanta per row
-_MV_DIAG, _MV_UP, _MV_LEFT, _MV_STOP = 0, 1, 2, 3
+_S = 4                       # pairs stacked per grid program
+_MV_DIAG, _MV_UP, _MV_LEFT = 0, 1, 2
 
 
 def available() -> bool:
-    """Opt-in (RACON_TPU_PALLAS_ALIGN=1): on the current deployment
-    the measured per-row cost of the wide-band left-chain leaves this
-    kernel slower end-to-end than the hybrid scan-ladder + CPU-WFA
-    path, so the polisher defaults to that; the kernel is kept (and
-    tested) as the single-dispatch option for transfer-latency-bound
-    deployments with narrower bands."""
+    """Default on real TPU backends (RACON_TPU_PALLAS_ALIGN=0 falls
+    back to the scan-ladder kernels): with 4 pairs sharing each row
+    group the kernel measures ~1.2 us/row including the traceback
+    pass, ~3x the scan ladder, in ONE dispatch per band rung."""
     if os.environ.get("RACON_TPU_NO_PALLAS"):
         return False
-    if not os.environ.get("RACON_TPU_PALLAS_ALIGN"):
+    if os.environ.get("RACON_TPU_PALLAS_ALIGN", "1") == "0":
         return False
     try:
         return jax.devices()[0].platform == "tpu"
@@ -69,54 +78,69 @@ def available() -> bool:
 
 
 def _kernel(ql_ref, tl_ref, q_ref, t_ref, tape_ref, dist_ref,
-            ckpt, dirs, regs_s, *,
-            lq: int, lt: int, wb: int):
-    i_prog = pl.program_id(0)
-    ql = ql_ref[i_prog]
-    tl = tl_ref[i_prog]
+            ckpt_hbm, ckstage, dirs, dsem, regs_s, *,
+            lq: int, lt: int, wb: int, ckrows: int):
+    g0 = pl.program_id(0) * _S
+    nck8 = (lq // ckrows + 1) * 8
+    ck0 = pl.program_id(0) * nck8      # this program's HBM region
     q = 128
-    nck = lq // _CKPT + 1
     tape_w = (lq + lt) // 16 + 1
     big = jnp.int32(_BIG)
     cols = lax.broadcasted_iota(jnp.int32, (1, wb), 1)
-    iota_c = lax.broadcasted_iota(jnp.int32, (1, _CKPT), 1)
-    nq = jnp.maximum(ql, 1)
-    smax_q = (jnp.maximum(tl + 1 - wb, 0) + q - 1) // q
+    cols_s = lax.broadcasted_iota(jnp.int32, (_S, wb), 1)
+    rows_s = lax.broadcasted_iota(jnp.int32, (_S, wb), 0)
+    iota_c = lax.broadcasted_iota(jnp.int32, (1, 128), 1)
 
-    def sqq(i):
-        """Quantized band start for row i: centered on the
-        proportional diagonal (symmetric margins >= wb/2 - 128; paths
-        deviate either side, unlike the POA layer DP)."""
-        return jnp.clip(((i * tl) // nq - (wb // 2)) // q, 0, smax_q)
+    qls = [ql_ref[g0 + s] for s in range(_S)]
+    tls = [tl_ref[g0 + s] for s in range(_S)]
+    nqs = [jnp.maximum(x, 1) for x in qls]
+    smaxs = [(jnp.maximum(tls[s] + 1 - wb, 0) + q - 1) // q
+             for s in range(_S)]
 
-    # t chars in u space: tb[c] = t[s + c] needs a 128-aligned slice,
-    # t_ref is padded by the wrapper so s + wb stays in range
-    def t_band(s):
-        return t_ref[0, :, pl.ds(pl.multiple_of(s, q), wb)]
+    def sqq(s, i):
+        """Quantized band start for pair s, row i: centered on the
+        proportional diagonal (symmetric margins >= wb/2 - 128)."""
+        return jnp.clip(((i * tls[s]) // nqs[s] - (wb // 2)) // q,
+                        0, smaxs[s])
+
+    def stackv(vals, dtype=jnp.int32):
+        """[_S] scalars -> [_S, 1] column vector."""
+        out = jnp.full((_S, 1), 0, dtype)
+        ri = lax.broadcasted_iota(jnp.int32, (_S, 1), 0)
+        for s, v in enumerate(vals):
+            out = jnp.where(ri == s, jnp.asarray(v, dtype), out)
+        return out
+
+    # tl as a broadcastable column; per-pair big mask rows beyond tl
+    tl_col = stackv(tls)
+
+    def t_band(starts):
+        """Stacked [S, wb] target chars at each pair's band start."""
+        rows = [t_ref[s, :, pl.ds(pl.multiple_of(starts[s], q), wb)]
+                for s in range(_S)]
+        return jnp.concatenate(rows, axis=0)
 
     def row_dp(i, pvp, qchars, i0):
-        """One DP row.  pvp: previous row D[i-1][s_{i-1} + c] padded
-        to wb + shift headroom.  Returns (row_u, dirs_row) where
-        row_u[c] = D[i][s_i + c]."""
-        sq_i = sqq(i)
-        s_i = sq_i * q
-        dq = sq_i - sqq(i - 1)
+        """One stacked DP row group.  pvp: [S, wb + shift headroom] of
+        D[i-1][s_{i-1} + c]; qchars: [S, _CKPT] of this block's query
+        chars.  Returns (row_u [S, wb], dirs_row [S, wb])."""
+        sq_i = [sqq(s, i) for s in range(_S)]
+        s_i = stackv([x * q for x in sq_i])
+        dq = stackv([sq_i[s] - sqq(s, i - 1) for s in range(_S)])
         pu = pvp[:, 0:wb]
         for mm in range(1, _N_SHIFT):
             pu = jnp.where(dq == mm, pvp[:, mm * q: mm * q + wb], pu)
-        qc = jnp.sum(jnp.where(iota_c == (i - 1 - i0), qchars, 0))
-        tb = t_band(s_i)
-        j_u = s_i + cols                 # column of slot c, u space
+        qc = jnp.sum(jnp.where(iota_c == (i - 1 - i0), qchars, 0),
+                     axis=1, keepdims=True)           # [S, 1]
+        tb = t_band([x * q for x in sq_i])
+        j_u = s_i + cols_s
         sub_u = jnp.where(tb == qc, 0, 1)
-        # vert/diag in u space (diag shifts right once, post-min)
         du = pu + sub_u
         vu = pu + 1
         t_u = jnp.minimum(jnp.pad(du, ((0, 0), (1, 0)),
                                   constant_values=big)[:, :wb], vu)
-        # boundary column j == 0 (cell D[i][0] = i) and out-of-range
         t_u2 = jnp.where(j_u == 0, i, t_u)
-        t_u2 = jnp.where(j_u > tl, big, t_u2)
-        # left chain: D[c] = min(T[c], D[c-1] + 1)
+        t_u2 = jnp.where(j_u > tl_col, big, t_u2)
         x = t_u2 - j_u
         sh = 1
         while sh < wb:
@@ -138,126 +162,182 @@ def _kernel(ql_ref, tl_ref, q_ref, t_ref, tape_ref, dist_ref,
                        constant_values=big)
 
     # ---- pass 1: forward scores, checkpoints every _CKPT rows -------
-    init = jnp.where(cols > tl, big, cols)       # D[0][j] = j, s_0 = 0
-    ckpt[0:1, :] = init
+    def ck_save(slot, rows4):
+        # tiled HBM slices must be 8-row aligned AND 8 rows long, so
+        # the staging buffer carries 4 live + 4 dead rows
+        ckstage[0:_S, :] = rows4
+        cp = pltpu.make_async_copy(
+            ckstage,
+            ckpt_hbm.at[pl.ds(pl.multiple_of(ck0 + slot * 8, 8),
+                              8), :],
+            dsem)
+        cp.start()
+        cp.wait()
+
+    def ck_load(slot):
+        cp = pltpu.make_async_copy(
+            ckpt_hbm.at[pl.ds(pl.multiple_of(ck0 + slot * 8, 8),
+                              8), :],
+            ckstage, dsem)
+        cp.start()
+        cp.wait()
+        return ckstage[0:_S, :]
+
+    init = jnp.where(cols_s > tl_col, big, cols_s)   # D[0][j] = j
+    ck_save(0, init)
+    max_ql = qls[0]
+    for s in range(1, _S):
+        max_ql = jnp.maximum(max_ql, qls[s])
+
+    def qchars_blk(i0):
+        # char window anchored to 128 lanes (ckrows may be 64)
+        i0b = (i0 // 128) * 128
+        rows = [q_ref[s, :, pl.ds(pl.multiple_of(i0b, 128), 128)]
+                for s in range(_S)]
+        return jnp.concatenate(rows, axis=0), i0b     # [S, 128]
+
+    ql_col1 = stackv(qls)
 
     def blk_fwd(bk, pv):
-        i0 = bk * _CKPT
-        qchars = q_ref[0, :, pl.ds(pl.multiple_of(i0, _CKPT), _CKPT)]
+        i0 = bk * ckrows
+        qchars, i0b = qchars_blk(i0)
 
         def row_step(i, pv):
-            row, _ = row_dp(i, pv, qchars, i0)
+            row, _ = row_dp(i, pv, qchars, i0b)
+            # a pair whose query ended keeps its final row frozen so
+            # the end score survives to the loop exit
+            row = jnp.where(ql_col1 < i, pv[:, 0:wb], row)
             return pad_row(row)
 
-        top = jnp.minimum((bk + 1) * _CKPT, ql)
+        top = jnp.minimum((bk + 1) * ckrows, max_ql)
         pv = lax.fori_loop(i0 + 1, top + 1, row_step, pv)
 
-        @pl.when(top == (bk + 1) * _CKPT)
+        @pl.when(top == (bk + 1) * ckrows)
         def _():
-            ckpt[pl.ds(bk + 1, 1), :] = pv[:, 0:wb]
+            ck_save(bk + 1, pv[:, 0:wb])
         return pv
 
-    nblk = (ql + _CKPT - 1) // _CKPT
+    nblk = (max_ql + ckrows - 1) // ckrows
     pv = lax.fori_loop(0, nblk, blk_fwd, pad_row(init))
 
-    c_end = tl - sqq(ql) * q
-    dist = jnp.sum(jnp.where(cols == jnp.clip(c_end, 0, wb - 1),
-                             pv[:, 0:wb], 0))
-    dist = jnp.where((c_end < 0) | (c_end >= wb), big, dist)
-    dist_ref[0, 0:1, 0:1] = jnp.full((1, 1), dist, jnp.int32)
+    # NOTE on the freeze: once i passes ql_s, pair s's row stops
+    # updating, so its band start must also stop moving -- sqq(s, i)
+    # with i > ql_s would drift.  The freeze keeps the row contents of
+    # row ql_s, whose band start is sqq(s, ql_s); the end-score read
+    # below uses exactly that start, so they agree.
+    for s in range(_S):
+        c_end = tls[s] - sqq(s, qls[s]) * q
+        dval = jnp.sum(jnp.where((rows_s == s) &
+                                 (cols_s == jnp.clip(c_end, 0,
+                                                     wb - 1)),
+                                 pv[:, 0:wb], 0))
+        dval = jnp.where((c_end < 0) | (c_end >= wb), big, dval)
+        dist_ref[s, 0:1, 0:1] = jnp.full((1, 1), dval, jnp.int32)
 
-    # ---- pass 2: checkpointed traceback -----------------------------
-    tape_ref[0, :, :] = jnp.zeros((tape_w, 1), jnp.int32)
-    # regs: 0 cur word, 1 word count, 2 bit count, 3 i, 4 j
-    regs_s[0] = jnp.int32(0)
-    regs_s[1] = jnp.int32(0)
-    regs_s[2] = jnp.int32(0)
-    regs_s[3] = ql
-    regs_s[4] = tl
+    # ---- pass 2: checkpointed traceback, all pairs per block --------
+    for s in range(_S):
+        tape_ref[s, :, :] = jnp.zeros((tape_w, 1), jnp.int32)
+    # regs per pair s at base s*8: 0 word, 1 word count, 2 bit count,
+    # 3 i, 4 j
+    for s in range(_S):
+        regs_s[s * 8 + 0] = jnp.int32(0)
+        regs_s[s * 8 + 1] = jnp.int32(0)
+        regs_s[s * 8 + 2] = jnp.int32(0)
+        regs_s[s * 8 + 3] = qls[s]
+        regs_s[s * 8 + 4] = tls[s]
 
-    def emit(mv):
-        w = regs_s[0] | (mv << (regs_s[2] * 2))
-        nb = regs_s[2] + 1
+    def emit(s, mv):
+        w = regs_s[s * 8] | (mv << (regs_s[s * 8 + 2] * 2))
+        nb = regs_s[s * 8 + 2] + 1
         full = nb == 16
 
         @pl.when(full)
         def _():
-            tape_ref[0, pl.ds(regs_s[1], 1), 0:1] = jnp.full(
+            tape_ref[s, pl.ds(regs_s[s * 8 + 1], 1), 0:1] = jnp.full(
                 (1, 1), w, jnp.int32)
-            regs_s[0] = jnp.int32(0)
-            regs_s[1] = regs_s[1] + 1
-            regs_s[2] = jnp.int32(0)
+            regs_s[s * 8] = jnp.int32(0)
+            regs_s[s * 8 + 1] = regs_s[s * 8 + 1] + 1
+            regs_s[s * 8 + 2] = jnp.int32(0)
 
         @pl.when(jnp.logical_not(full))
         def _():
-            regs_s[0] = w
-            regs_s[2] = nb
+            regs_s[s * 8] = w
+            regs_s[s * 8 + 2] = nb
 
     def blk_bwd(bkr, _):
         bk = nblk - 1 - bkr
-        i0 = bk * _CKPT
+        i0 = bk * ckrows
+        any_here = regs_s[3] > i0
+        for s in range(1, _S):
+            any_here = any_here | (regs_s[s * 8 + 3] > i0)
 
-        @pl.when(regs_s[3] > i0)
+        @pl.when(any_here)
         def _():
             # rebuild this block's direction rows from its checkpoint
-            qchars = q_ref[0, :, pl.ds(pl.multiple_of(i0, _CKPT), _CKPT)]
+            qchars, i0b = qchars_blk(i0)
 
             def row_step(i, pv):
-                row, dr = row_dp(i, pv, qchars, i0)
-                dirs[pl.ds(i - 1 - i0, 1), :] = dr
+                row, dr = row_dp(i, pv, qchars, i0b)
+                dirs[pl.ds(pl.multiple_of((i - 1 - i0) * 8, 8),
+                           _S), :] = dr
+                row = jnp.where(ql_col1 < i, pv[:, 0:wb], row)
                 return pad_row(row)
 
-            top = jnp.minimum(i0 + _CKPT, ql)
-            pv0 = pad_row(ckpt[pl.ds(bk, 1), :])
+            top = jnp.minimum(i0 + ckrows, max_ql)
+            pv0 = pad_row(ck_load(bk))
             lax.fori_loop(i0 + 1, top + 1, row_step, pv0)
 
-            # walk while inside this block
-            def w_cond2(c):
-                i = c[0]
-                j = c[1]
-                return (i > i0) | ((i0 == 0) & ((i > 0) | (j > 0)))
+            for s in range(_S):
+                def w_cond(c):
+                    i, j = c
+                    return (i > i0) | ((i0 == 0) &
+                                       ((i > 0) | (j > 0)))
 
-            def w_body(c):
-                i, j = c
+                def w_body(c, s=s):
+                    i, j = c
 
-                @pl.when(i == 0)
-                def _():
-                    emit(jnp.int32(_MV_LEFT))
+                    @pl.when(i == 0)
+                    def _():
+                        emit(s, jnp.int32(_MV_LEFT))
 
-                @pl.when(i > 0)
-                def _():
-                    s_i = sqq(i) * q
-                    cc = jnp.clip(j - s_i, 0, wb - 1)
-                    drow = dirs[pl.ds(i - 1 - i0, 1), :]
-                    mv = jnp.sum(jnp.where(cols == cc, drow, 0))
-                    mv = jnp.where(j <= 0, _MV_UP, mv)
-                    emit(mv)
-                    regs_s[3] = jnp.where(mv != _MV_LEFT, i - 1, i)
-                    regs_s[4] = jnp.where(mv != _MV_UP, j - 1, j)
+                    @pl.when(i > 0)
+                    def _():
+                        s_i = sqq(s, i) * q
+                        cc = jnp.clip(j - s_i, 0, wb - 1)
+                        drow = dirs[pl.ds((i - 1 - i0) * 8 + s,
+                                          1), :]
+                        mv = jnp.sum(jnp.where(cols == cc, drow, 0))
+                        mv = jnp.where(j <= 0, _MV_UP, mv)
+                        emit(s, mv)
+                        regs_s[s * 8 + 3] = jnp.where(mv != _MV_LEFT,
+                                                      i - 1, i)
+                        regs_s[s * 8 + 4] = jnp.where(mv != _MV_UP,
+                                                      j - 1, j)
+                    ni = jnp.where(i == 0, i, regs_s[s * 8 + 3])
+                    nj = jnp.where(i == 0, j - 1, regs_s[s * 8 + 4])
+                    regs_s[s * 8 + 3] = ni
+                    regs_s[s * 8 + 4] = nj
+                    return ni, nj
 
-                ni = jnp.where(i == 0, i, regs_s[3])
-                nj = jnp.where(i == 0, j - 1, regs_s[4])
-                regs_s[3] = ni
-                regs_s[4] = nj
-                return ni, nj
-
-            ii, jj = lax.while_loop(w_cond2, w_body,
-                                    (regs_s[3], regs_s[4]))
-            regs_s[3] = ii
-            regs_s[4] = jj
+                ii, jj = lax.while_loop(
+                    w_cond, w_body,
+                    (regs_s[s * 8 + 3], regs_s[s * 8 + 4]))
+                regs_s[s * 8 + 3] = ii
+                regs_s[s * 8 + 4] = jj
         return 0
 
     lax.fori_loop(0, nblk, blk_bwd, 0)
-    # flush the partial word + record the tape length
-    @pl.when(regs_s[2] > 0)
-    def _():
-        tape_ref[0, pl.ds(regs_s[1], 1), 0:1] = jnp.full(
-            (1, 1), regs_s[0], jnp.int32)
-        regs_s[1] = regs_s[1] + 1
-    dist_ref[0, 1:2, 0:1] = jnp.full(
-        (1, 1), regs_s[1] * 16 - jnp.where(regs_s[2] > 0,
-                                           16 - regs_s[2], 0),
-        jnp.int32)
+    for s in range(_S):
+        @pl.when(regs_s[s * 8 + 2] > 0)
+        def _(s=s):
+            tape_ref[s, pl.ds(regs_s[s * 8 + 1], 1), 0:1] = jnp.full(
+                (1, 1), regs_s[s * 8], jnp.int32)
+            regs_s[s * 8 + 1] = regs_s[s * 8 + 1] + 1
+        dist_ref[s, 1:2, 0:1] = jnp.full(
+            (1, 1),
+            regs_s[s * 8 + 1] * 16 - jnp.where(
+                regs_s[s * 8 + 2] > 0, 16 - regs_s[s * 8 + 2], 0),
+            jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnums=(4, 5, 6))
@@ -267,34 +347,43 @@ def _align(q, t, ql, tl, lq: int, lt: int, wb: int):
     q_i = q.astype(jnp.int32)[:, None, :]
     t_i = jnp.pad(t.astype(jnp.int32), ((0, 0), (0, wb + 128)),
                   constant_values=-1)[:, None, :]
-    kern = functools.partial(_kernel, lq=lq, lt=lt, wb=wb)
+    ckrows = _ckrows(wb)
+    kern = functools.partial(_kernel, lq=lq, lt=lt, wb=wb,
+                             ckrows=ckrows)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b,),
+        grid=(b // _S,),
         in_specs=[
-            pl.BlockSpec((1, 1, lq), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((_S, 1, lq), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, lt + wb + 128), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((_S, 1, lt + wb + 128),
+                         lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((1, tape_w, 1), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((_S, tape_w, 1), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, 1), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((_S, 8, 1), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),      # ckpt HBM buffer
         ),
         scratch_shapes=[
-            pltpu.VMEM((lq // _CKPT + 1, wb), jnp.int32),   # ckpt
-            pltpu.VMEM((_CKPT, wb), jnp.int32),             # dirs
-            pltpu.SMEM((8,), jnp.int32),                    # regs
+            pltpu.VMEM((8, wb), jnp.int32),                    # stage
+            pltpu.VMEM((ckrows * 8, wb), jnp.int32),           # dirs
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SMEM((8 * _S,), jnp.int32),                  # regs
         ],
     )
-    return pl.pallas_call(
+    nck8 = (lq // ckrows + 1) * 8
+    tape, meta, _ = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct((b, tape_w, 1), jnp.int32),
-                   jax.ShapeDtypeStruct((b, 8, 1), jnp.int32)),
+                   jax.ShapeDtypeStruct((b, 8, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((b // _S * nck8, wb),
+                                        jnp.int32)),
     )(ql, tl, q_i, t_i)
+    return tape, meta
 
 
 def align_batch(queries, targets, lq: int, lt: int, wb: int):
@@ -302,17 +391,25 @@ def align_batch(queries, targets, lq: int, lt: int, wb: int):
 
     moves: [B, n] uint8 of 2-bit codes in traceback (reversed) order,
     lens: [B] number of valid moves, dists: [B] band edit distance
-    (_BIG when the endpoint fell outside the band).
+    (_BIG when the endpoint fell outside the band).  The batch is
+    padded to a multiple of the per-program stacking factor.
     """
     from racon_tpu.tpu.aligner import encode_batch, _QPAD, _TPAD
 
+    n_real = len(queries)
+    # pad the pair count to a power of two so grid sizes (and thus
+    # compiled variants) stay bucketed; empty pairs cost ~nothing
+    from racon_tpu.utils.tuning import pow2_at_least
+    n_pad = pow2_at_least(max(n_real, _S), _S)
+    queries = list(queries) + [b""] * (n_pad - n_real)
+    targets = list(targets) + [b""] * (n_pad - n_real)
     q = encode_batch(queries, lq, _QPAD)
     t = encode_batch(targets, lt, _TPAD)
     ql = np.array([len(s) for s in queries], np.int32)
     tl = np.array([len(s) for s in targets], np.int32)
     tape, meta = _align(q, t, ql, tl, lq, lt, wb)
-    tape = np.asarray(tape)[:, :, 0].astype(np.uint32)
-    meta = np.asarray(meta)[:, :, 0]
+    tape = np.asarray(tape)[:n_real, :, 0].astype(np.uint32)
+    meta = np.asarray(meta)[:n_real, :, 0]
     n = tape.shape[1] * 16
     moves = np.zeros((tape.shape[0], n), np.uint8)
     for sh in range(16):

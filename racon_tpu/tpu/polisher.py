@@ -317,35 +317,59 @@ class TPUPolisher(Polisher):
 
         pending.sort(key=lambda x: -x[0])
 
-        from racon_tpu.tpu import align_pallas
-        if align_pallas.available():
-            cut = _split_cut(
-                [p[0] for p in pending],
-                float(os.environ.get("RACON_TPU_ALIGN_SPLIT", "0.5")))
-            cpu_share = [o for _, _, o in pending[cut:]]
-            futures = [self._pool.submit(
-                lambda o: o.find_breaking_points(
-                    self.sequences, self.window_length,
-                    aligner=cpu_ops.align), o) for o in cpu_share]
-            if cut:
-                self._pallas_align([o for _, _, o in pending[:cut]])
-            for f in futures:
-                f.result()
-            return
-
         n_workers = max(1, self._pool._max_workers - 1)
         if os.environ.get("RACON_TPU_ALIGN_DEVICE_ONLY"):
             n_workers = 0
         steal = bool(os.environ.get("RACON_TPU_STEAL")) and n_workers
         work = deque(pending)
+        from racon_tpu.tpu import align_pallas as _ap
         if steal or not n_workers:
             dev_left = len(pending)
+        elif _ap.available() and "RACON_TPU_ALIGN_SPLIT" not in \
+                os.environ:
+            # deterministic rate-model boundary: the stacked kernel's
+            # cost is ~linear in pair length (~1.2 us/row) while the
+            # CPU WFA's is ~quadratic (O(N + D^2), D ~ 15-20% of N);
+            # pick the cut minimizing the slower engine's predicted
+            # time.  Pure function of the input -> byte-reproducible.
+            dims = [p[0] for p in pending]
+            dev_pre = [0]
+            for d in dims:
+                # stacked kernel handles >=8192 buckets (~1.2 us/row);
+                # smaller pairs run the ~3x-slower scan ladder
+                rate = 1200 if d >= 8192 else 3600
+                dev_pre.append(dev_pre[-1] + d * rate)       # ns
+            cpu_total = sum(d * d for d in dims)
+            best, dev_left = None, len(pending)
+            cpu_suf = cpu_total
+            for k in range(len(pending) + 1):
+                if k:
+                    cpu_suf -= dims[k - 1] * dims[k - 1]
+                t = max(dev_pre[k], cpu_suf / max(1, n_workers))
+                if best is None or t < best:
+                    best, dev_left = t, k
         else:
             # deterministic static boundary (see the POA stage): the
             # CPU owns the small-bucket tail past the cut
             dev_left = _split_cut(
                 [p[0] for p in pending],
-                float(os.environ.get("RACON_TPU_ALIGN_SPLIT", "0.5")))
+                float(os.environ.get("RACON_TPU_ALIGN_SPLIT",
+                                     "0.5")))
+        # the stacked Pallas kernel clears FEW BIG pairs ~3x faster
+        # than the scan ladder (one dispatch, dynamic row loops), but
+        # the batched scan kernels win on MANY SMALL pairs (hundreds
+        # of lanes amortize each scan step) -- route by bucket size,
+        # peeling big pairs off the device-owned prefix
+        from racon_tpu.tpu import align_pallas
+        pallas_big = []
+        if align_pallas.available():
+            region = len(work) if steal or not n_workers else dev_left
+            nbig = 0
+            while work and nbig < region and work[0][0] >= 8192:
+                pallas_big.append(work.popleft()[2])
+                nbig += 1
+            dev_left = max(0, dev_left - nbig)
+
         lock = threading.Lock()
         n_cpu_done = 0
 
@@ -363,6 +387,12 @@ class TPUPolisher(Polisher):
 
         workers = [self._pool.submit(cpu_worker)
                    for _ in range(n_workers)]
+
+        if pallas_big:
+            self._pallas_align(pallas_big)
+            self.logger.log(
+                f"[racon_tpu::TPUPolisher::align] device-aligned "
+                f"{len(pallas_big)} large overlaps (stacked kernel)")
 
         n_dev = len(self.mesh.devices)
         n_done = 0
@@ -424,11 +454,11 @@ class TPUPolisher(Polisher):
         need = [max(dabs[i], max(len(q), len(t)) // 5)
                 for i, (q, t) in enumerate(zip(queries, targets))]
         pending = list(range(len(overlaps)))
-        for wb in (1024, 2048, 4096, 8192):
+        for wb in (2048, 4096):
             if not pending or wb - 512 > 2 * bd:
                 break
             idx = [i for i in pending
-                   if need[i] + dabs[i] <= wb - 512 or wb == 8192]
+                   if need[i] + dabs[i] <= wb - 512 or wb == 4096]
             if not idx:
                 continue
             moves, lens, dists = align_pallas.align_batch(
@@ -450,6 +480,8 @@ class TPUPolisher(Polisher):
                 f"[racon_tpu::TPUPolisher::align] device-aligned "
                 f"{len(idx) - len(still)}/{len(idx)} overlaps "
                 f"(band {wb})")
+        # survivors lack a CIGAR and take the CPU fall-through
+        # (the reference's exceeded_max_alignment_difference skip)
 
     def _align_chunk(self, chunk: List[Overlap], blq: int, blt: int,
                      n_dev: int) -> None:
