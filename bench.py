@@ -82,11 +82,12 @@ def main():
     log(f"[bench] jax devices: {jax.devices()}")
 
     cpu_wall, cpu_out, _ = run_polish()
-    # same sampling as the accelerated path (min of two) so run noise
-    # doesn't bias vs_baseline either way
-    cpu_wall2, cpu_out2, _ = run_polish()
-    if cpu_wall2 < cpu_wall:
-        cpu_wall, cpu_out = cpu_wall2, cpu_out2
+    # same sampling depth as the accelerated path (min of three) so
+    # run noise doesn't bias vs_baseline either way
+    for _ in range(2):
+        cpu_wall2, cpu_out2, _ = run_polish()
+        if cpu_wall2 < cpu_wall:
+            cpu_wall, cpu_out = cpu_wall2, cpu_out2
     cpu_dist = accuracy(cpu_out)
     log(f"[bench] CPU path: {cpu_wall:.2f}s, edit distance {cpu_dist} "
         "(reference CPU golden 1312, test/racon_test.cpp:107)")
@@ -101,13 +102,16 @@ def main():
         log(f"[bench] TPU path (cold, incl. compiles): {cold_wall:.2f}s")
         accel_wall, accel_out, pol = run_polish(tpu_poa_batches=1,
                                                 tpu_aligner_batches=1)
-        # second warm sample: the tunneled host shows +-20% run noise,
-        # so the headline takes the faster of two steady-state runs
-        accel_wall2, accel_out2, pol2 = run_polish(
-            tpu_poa_batches=1, tpu_aligner_batches=1)
-        if accel_wall2 < accel_wall:
-            accel_wall, accel_out, pol = (accel_wall2, accel_out2,
-                                          pol2)
+        # more warm samples: the tunneled host shows +-20% run noise
+        # (transfer latency jitter), so the headline takes the fastest
+        # steady-state run; all runs must stay byte-identical
+        warm_outs = [accel_out]
+        for _ in range(2):
+            w2, o2, p2 = run_polish(tpu_poa_batches=1,
+                                    tpu_aligner_batches=1)
+            warm_outs.append(o2)
+            if w2 < accel_wall:
+                accel_wall, accel_out, pol = w2, o2, p2
         accel_dist = accuracy(accel_out)
         align_s = pol.stage_walls.get("device_align", 0.0)
         poa_s = pol.stage_walls.get("device_poa", 0.0)
@@ -126,7 +130,7 @@ def main():
         deterministic = all(
             len(cold_out) == len(o) and all(
                 a.data == b.data for a, b in zip(cold_out, o))
-            for o in (accel_out, accel_out2))
+            for o in warm_outs)
         log(f"[bench] TPU path deterministic across runs: "
             f"{deterministic}")
         extra = {

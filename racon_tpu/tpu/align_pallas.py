@@ -408,6 +408,8 @@ def align_batch(queries, targets, lq: int, lt: int, wb: int):
     ql = np.array([len(s) for s in queries], np.int32)
     tl = np.array([len(s) for s in targets], np.int32)
     tape, meta = _align(q, t, ql, tl, lq, lt, wb)
+    tape.copy_to_host_async()
+    meta.copy_to_host_async()
     tape = np.asarray(tape)[:n_real, :, 0].astype(np.uint32)
     meta = np.asarray(meta)[:n_real, :, 0]
     n = tape.shape[1] * 16

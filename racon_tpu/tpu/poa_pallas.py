@@ -853,4 +853,9 @@ def poa_full_batch(seqs, wts, meta, nlay, bblen, *,
         jnp.asarray(seqs), jnp.asarray(wts), jnp.asarray(meta),
         jnp.asarray(nlay), jnp.asarray(bblen),
         v, lp, d1, p, s, a, k, wb, match, mismatch, gap, wtype, trim)
+    # start both device->host copies before blocking on either: the
+    # tunnel's per-transfer latency dominates, so pipelining them
+    # saves one round trip
+    cons.copy_to_host_async()
+    mout.copy_to_host_async()
     return np.asarray(cons)[:, :, 0], np.asarray(mout)[:, :, 0]

@@ -360,9 +360,8 @@ class TPUPolisher(Polisher):
         # the batched scan kernels win on MANY SMALL pairs (hundreds
         # of lanes amortize each scan step) -- route by bucket size,
         # peeling big pairs off the device-owned prefix
-        from racon_tpu.tpu import align_pallas
         pallas_big = []
-        if align_pallas.available():
+        if _ap.available():
             region = len(work) if steal or not n_workers else dev_left
             nbig = 0
             while work and nbig < region and work[0][0] >= 8192:
@@ -457,8 +456,11 @@ class TPUPolisher(Polisher):
         for wb in (2048, 4096):
             if not pending or wb - 512 > 2 * bd:
                 break
+            # the forced last rung still skips pairs that provably
+            # cannot certify (distance >= dabs)
             idx = [i for i in pending
-                   if need[i] + dabs[i] <= wb - 512 or wb == 4096]
+                   if need[i] + dabs[i] <= wb - 512
+                   or (wb == 4096 and 2 * dabs[i] <= wb - 512)]
             if not idx:
                 continue
             moves, lens, dists = align_pallas.align_batch(
