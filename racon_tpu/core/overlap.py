@@ -31,8 +31,8 @@ class InvalidInputError(RuntimeError):
 class Overlap:
     __slots__ = ("q_name", "q_id", "q_begin", "q_end", "q_length",
                  "t_name", "t_id", "t_begin", "t_end", "t_length",
-                 "strand", "length", "error", "cigar", "is_valid",
-                 "is_transmuted", "breaking_points")
+                 "strand", "length", "error", "cigar", "cigar_runs",
+                 "is_valid", "is_transmuted", "breaking_points")
 
     def __init__(self):
         self.q_name: Optional[str] = None
@@ -49,6 +49,7 @@ class Overlap:
         self.length = 0
         self.error = 0.0
         self.cigar: str = ""
+        self.cigar_runs = None     # (lengths, codes) device fast path
         self.is_valid = True
         self.is_transmuted = False
         self.breaking_points: Optional[np.ndarray] = None  # (2k, 2) [t, q]
@@ -205,7 +206,7 @@ class Overlap:
             raise InvalidInputError("overlap is not transmuted")
         if self.breaking_points is not None:
             return
-        if not self.cigar:
+        if not self.cigar and self.cigar_runs is None:
             if aligner is None:
                 raise InvalidInputError(
                     "overlap has no CIGAR and no aligner was provided")
@@ -213,6 +214,7 @@ class Overlap:
                                  self.target_span(sequences))
         self.find_breaking_points_from_cigar(window_length)
         self.cigar = ""
+        self.cigar_runs = None
 
     def find_breaking_points_from_cigar(self, window_length: int) -> None:
         """Vectorised CIGAR walk (reference: src/overlap.cpp:226-292).
@@ -222,14 +224,25 @@ class Overlap:
         the last match.
         """
         w = window_length
-        ops = _CIGAR_RE.findall(self.cigar.encode())
-        if not ops:
+        if self.cigar_runs is not None:
+            # fast path: device aligners hand over (lengths, codes)
+            # run arrays directly, skipping the CIGAR string round
+            # trip (build + regex parse cost ~30 ms per long overlap)
+            lengths, codes = self.cigar_runs
+            lengths = lengths.astype(np.int64, copy=False)
+            codes = codes.astype(np.int64, copy=False)
+        else:
+            ops = _CIGAR_RE.findall(self.cigar.encode())
+            if not ops:
+                self.breaking_points = np.empty((0, 2), dtype=np.int64)
+                return
+            lengths = np.array([int(n) for n, _ in ops],
+                               dtype=np.int64)
+            codes = np.array([b"MIDNSHP=X".index(op)
+                              for _, op in ops], dtype=np.int64)
+        if lengths.size == 0:
             self.breaking_points = np.empty((0, 2), dtype=np.int64)
             return
-
-        lengths = np.array([int(n) for n, _ in ops], dtype=np.int64)
-        codes = np.array([b"MIDNSHP=X".index(op) for _, op in ops],
-                         dtype=np.int64)
         # advance masks per op: M(0) = X(8) = '='(7) advance both;
         # I(1) query; D(2)/N(3) target; S/H/P consume nothing.
         advances_t = np.isin(codes, (0, 2, 3, 7, 8))

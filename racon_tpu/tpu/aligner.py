@@ -377,6 +377,24 @@ def band_align_batch(queries: Sequence[bytes], targets: Sequence[bytes],
     return ops_out, cells, pending
 
 
+# op code -> "MIDNSHP=X" index for the breaking-points fast path
+_RUN_CODE = np.array([0, 7, 8, 1, 2], dtype=np.int64)
+
+
+def ops_to_runs(ops_row: np.ndarray):
+    """RLE a reversed op tape row into (lengths, codes) arrays in the
+    Overlap.cigar_runs convention ("MIDNSHP=X" indices), skipping the
+    CIGAR string entirely."""
+    fwd = ops_row[ops_row != OP_STOP][::-1]
+    if fwd.size == 0:
+        return (np.empty(0, np.int64), np.empty(0, np.int64))
+    change = np.flatnonzero(np.diff(fwd)) + 1
+    starts = np.concatenate(([0], change))
+    ends = np.concatenate((change, [fwd.size]))
+    return ((ends - starts).astype(np.int64),
+            _RUN_CODE[fwd[starts].astype(np.int64)])
+
+
 def ops_to_cigar(ops_row: np.ndarray) -> str:
     """RLE a reversed op tape row into a standard =/X/I/D CIGAR."""
     ops_row = ops_row[ops_row != OP_STOP][::-1]

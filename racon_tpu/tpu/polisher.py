@@ -472,7 +472,7 @@ class TPUPolisher(Polisher):
                 if dists[k] + dabs[i] <= wb - 512:
                     ops = align_pallas.moves_to_ops(
                         moves[k], int(lens[k]), queries[i], targets[i])
-                    overlaps[i].cigar = aligner.ops_to_cigar(ops)
+                    overlaps[i].cigar_runs = aligner.ops_to_runs(ops)
                 else:
                     still.add(i)
             idx_set = set(idx)
@@ -520,4 +520,4 @@ class TPUPolisher(Polisher):
         skip = set(unresolved.tolist())
         for idx, o in enumerate(chunk):
             if idx not in skip:
-                o.cigar = aligner.ops_to_cigar(ops[idx])
+                o.cigar_runs = aligner.ops_to_runs(ops[idx])

@@ -121,3 +121,40 @@ def test_transmute_name_resolution():
     o2 = Overlap.from_paf("unknown", 100, 0, 90, "+", "ctg", 400, 10, 100)
     o2.transmute(seqs, name_to_id, {})
     assert not o2.is_valid
+
+
+def test_cigar_runs_fast_path_matches_string_path():
+    """Device aligners hand (lengths, codes) run arrays to the
+    breaking-points walk; the result must equal the CIGAR-string
+    path's."""
+    import numpy as np
+
+    from racon_tpu.tpu import aligner as al
+
+    rng = np.random.default_rng(3)
+    ops = rng.choice(
+        [al.OP_EQ, al.OP_X, al.OP_I, al.OP_D], size=4000,
+        p=[0.82, 0.08, 0.05, 0.05]).astype(np.uint8)
+    tape = np.concatenate([ops[::-1], [al.OP_STOP] * 16]).astype(
+        np.uint8)
+
+    def mk():
+        o = Overlap()
+        o.q_begin, o.q_length = 0, 5000
+        o.t_begin, o.t_length = 100, 6000
+        o.strand = False
+        n_t = int(np.isin(ops, (al.OP_EQ, al.OP_X, al.OP_D)).sum())
+        n_q = int(np.isin(ops, (al.OP_EQ, al.OP_X, al.OP_I)).sum())
+        o.q_end = o.q_begin + n_q
+        o.t_end = o.t_begin + n_t
+        o.is_transmuted = True
+        return o
+
+    a = mk()
+    a.cigar = al.ops_to_cigar(tape)
+    a.find_breaking_points_from_cigar(500)
+    b = mk()
+    b.cigar_runs = al.ops_to_runs(tape)
+    b.find_breaking_points_from_cigar(500)
+    assert np.array_equal(a.breaking_points, b.breaking_points)
+    assert a.breaking_points.size > 0
