@@ -25,6 +25,29 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 
+def interpret_mode() -> bool:
+    """Pallas kernels run in interpret mode on non-TPU backends (the
+    CPU dryrun mesh and the sharding tests)."""
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except Exception:
+        return True
+
+
+def shard_batch_map(fn, mesh: Mesh, n_in: int, n_out: int):
+    """``shard_map`` over the 1-D batch axis with Pallas-friendly
+    settings (the vma/rep output check is off: ``pallas_call``
+    out_shapes carry no vma annotation)."""
+    spec = P("batch")
+    out = spec if n_out == 1 else (spec,) * n_out
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=(spec,) * n_in,
+                         out_specs=out, check_vma=False)
+    except TypeError:  # older jax spells it check_rep
+        return shard_map(fn, mesh=mesh, in_specs=(spec,) * n_in,
+                         out_specs=out, check_rep=False)
+
+
 def default_mesh(max_devices: Optional[int] = None) -> Mesh:
     """1-D mesh over all (or the first ``max_devices``) local devices."""
     devices = jax.devices()

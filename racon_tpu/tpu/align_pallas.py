@@ -71,6 +71,8 @@ def available() -> bool:
         return False
     if os.environ.get("RACON_TPU_PALLAS_ALIGN", "1") == "0":
         return False
+    if os.environ.get("RACON_TPU_PALLAS_INTERPRET") == "1":
+        return True
     try:
         return jax.devices()[0].platform == "tpu"
     except Exception:
@@ -340,8 +342,9 @@ def _kernel(ql_ref, tl_ref, q_ref, t_ref, tape_ref, dist_ref,
             jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5, 6))
-def _align(q, t, ql, tl, lq: int, lt: int, wb: int):
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7))
+def _align(q, t, ql, tl, lq: int, lt: int, wb: int,
+           interpret: bool = False):
     b = q.shape[0]
     tape_w = (lq + lt) // 16 + 1
     q_i = q.astype(jnp.int32)[:, None, :]
@@ -382,32 +385,60 @@ def _align(q, t, ql, tl, lq: int, lt: int, wb: int):
                    jax.ShapeDtypeStruct((b, 8, 1), jnp.int32),
                    jax.ShapeDtypeStruct((b // _S * nck8, wb),
                                         jnp.int32)),
+        interpret=interpret,
     )(ql, tl, q_i, t_i)
     return tape, meta
 
 
-def align_batch(queries, targets, lq: int, lt: int, wb: int):
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "lq", "lt", "wb",
+                                    "interpret"))
+def _align_sharded(q, t, ql, tl, *, mesh, lq: int, lt: int, wb: int,
+                   interpret: bool):
+    """The stacked kernel sharded over the mesh batch axis (one grid
+    of programs per device, no collectives — the analog of the
+    reference's per-device aligner queues, cudapolisher.cpp:170-188)."""
+    from racon_tpu.parallel.mesh_utils import shard_batch_map
+
+    def shard_fn(q, t, ql, tl):
+        return _align(q, t, ql, tl, lq, lt, wb, interpret)
+
+    return shard_batch_map(shard_fn, mesh, 4, 2)(q, t, ql, tl)
+
+
+def align_batch(queries, targets, lq: int, lt: int, wb: int,
+                mesh=None):
     """Align padded pair batches; returns (moves, lens, dists).
 
     moves: [B, n] uint8 of 2-bit codes in traceback (reversed) order,
     lens: [B] number of valid moves, dists: [B] band edit distance
     (_BIG when the endpoint fell outside the band).  The batch is
-    padded to a multiple of the per-program stacking factor.
+    padded to a multiple of the per-program stacking factor (and of
+    the mesh size, over which the batch axis is sharded).
     """
     from racon_tpu.tpu.aligner import encode_batch, _QPAD, _TPAD
 
     n_real = len(queries)
+    n_dev = len(mesh.devices) if mesh is not None else 1
     # pad the pair count to a power of two so grid sizes (and thus
     # compiled variants) stay bucketed; empty pairs cost ~nothing
     from racon_tpu.utils.tuning import pow2_at_least
     n_pad = pow2_at_least(max(n_real, _S), _S)
+    n_pad += (-n_pad) % (_S * n_dev)
     queries = list(queries) + [b""] * (n_pad - n_real)
     targets = list(targets) + [b""] * (n_pad - n_real)
     q = encode_batch(queries, lq, _QPAD)
     t = encode_batch(targets, lt, _TPAD)
     ql = np.array([len(s) for s in queries], np.int32)
     tl = np.array([len(s) for s in targets], np.int32)
-    tape, meta = _align(q, t, ql, tl, lq, lt, wb)
+    from racon_tpu.parallel.mesh_utils import interpret_mode
+
+    interp = interpret_mode()
+    if n_dev > 1:
+        tape, meta = _align_sharded(q, t, ql, tl, mesh=mesh, lq=lq,
+                                    lt=lt, wb=wb, interpret=interp)
+    else:
+        tape, meta = _align(q, t, ql, tl, lq, lt, wb, interp)
     tape.copy_to_host_async()
     meta.copy_to_host_async()
     tape = np.asarray(tape)[:n_real, :, 0].astype(np.uint32)

@@ -426,33 +426,34 @@ class TPUPoaBatchEngine:
         None when the window overflowed the device caps and must be
         re-polished on the CPU (reference: cudapolisher.cpp:357-386).
 
-        On a real TPU backend the whole POA runs inside ONE Pallas
-        kernel call (racon_tpu/tpu/poa_pallas.py, the cudapoa-shaped
-        design); elsewhere (CPU mesh dryrun, multi-device shard_map)
-        the portable lockstep lax.scan engine below is used.
+        On a TPU backend (or with Pallas interpret mode forced) the
+        whole POA runs inside ONE Pallas dispatch
+        (racon_tpu/tpu/poa_pallas.py, the cudapoa-shaped design),
+        sharded over the mesh batch axis when the mesh has more than
+        one device; otherwise the portable lockstep lax.scan engine
+        below is used.
         """
-        if self.mesh is None:
-            from racon_tpu.tpu import poa_pallas
-            if poa_pallas.available():
-                # the kernel's window type is a compile-time constant;
-                # split mixed batches so each window trims per its own
-                # type (parity with the per-window lockstep/CPU paths).
-                # _run_full_device returns None when the configuration
-                # exceeds the kernel's VMEM budget -> lockstep below.
-                types = {w.type.value for w in windows}
-                if self._fits_full_device(windows):
-                    if len(types) <= 1:
-                        return self._run_full_device(windows, trim)
-                    results: List[Tuple[Optional[bytes], bool]] = \
-                        [None] * len(windows)
-                    for tv in sorted(types):
-                        idxs = [i for i, w in enumerate(windows)
-                                if w.type.value == tv]
-                        sub = self._run_full_device(
-                            [windows[i] for i in idxs], trim)
-                        for i, r in zip(idxs, sub):
-                            results[i] = r
-                    return results
+        from racon_tpu.tpu import poa_pallas
+        if poa_pallas.available():
+            # the kernel's window type is a compile-time constant;
+            # split mixed batches so each window trims per its own
+            # type (parity with the per-window lockstep/CPU paths).
+            # _fits_full_device rejects configurations that exceed the
+            # kernel's VMEM budget -> lockstep below.
+            types = {w.type.value for w in windows}
+            if self._fits_full_device(windows):
+                if len(types) <= 1:
+                    return self._run_full_device(windows, trim)
+                results: List[Tuple[Optional[bytes], bool]] = \
+                    [None] * len(windows)
+                for tv in sorted(types):
+                    idxs = [i for i, w in enumerate(windows)
+                            if w.type.value == tv]
+                    sub = self._run_full_device(
+                        [windows[i] for i in idxs], trim)
+                    for i, r in zip(idxs, sub):
+                        results[i] = r
+                return results
         n = len(windows)
         nb = _NativeBatch(n)
         try:
@@ -490,6 +491,25 @@ class TPUPoaBatchEngine:
         """Callers must have passed _fits_full_device first."""
         from racon_tpu.tpu import poa_pallas
         from racon_tpu.utils.tuning import pow2_at_least
+
+        # <3-sequence windows keep the backbone verbatim (reference:
+        # cudabatch.cpp:214-222) -- short-circuit them before packing
+        # so they cost no device work or d1/b_pad head-room
+        if any(len(w.sequences) < 3 for w in windows):
+            out: List[Tuple[Optional[bytes], bool]] = \
+                [None] * len(windows)
+            dev_idx = []
+            for i, w in enumerate(windows):
+                if len(w.sequences) < 3:
+                    out[i] = (w.sequences[0], False)
+                else:
+                    dev_idx.append(i)
+            if dev_idx:
+                sub = self._run_full_device(
+                    [windows[i] for i in dev_idx], trim)
+                for i, r in zip(dev_idx, sub):
+                    out[i] = r
+            return out
 
         n = len(windows)
         layer_lists = [self._order_layers(w) for w in windows]
@@ -543,7 +563,8 @@ class TPUPoaBatchEngine:
             seqs, wts, meta, nlay, bblen, v=v, lp=lp, d1=d1,
             p=self.pcap, s=self.pcap, a=8, k=self.kcap, wb=wb,
             match=self.match, mismatch=self.mismatch, gap=self.gap,
-            wtype=windows[0].type.value, trim=1 if trim else 0)
+            wtype=windows[0].type.value, trim=1 if trim else 0,
+            mesh=self.mesh)
         self.phase_walls["dispatch"] += time.monotonic() - t0
         self.n_rounds += 1
         self.cells += int(mout[:n, 4].sum()) * wb
@@ -555,11 +576,6 @@ class TPUPoaBatchEngine:
                     poa_pallas.FAIL_KCAP: -3, poa_pallas.FAIL_PATH: -3}
         for b, w in enumerate(windows):
             length = int(mout[b, 0])
-            if len(w.sequences) < 3:
-                # raw-count gate, like the reference
-                # (cudabatch.cpp:214-222): backbone verbatim, unpolished
-                results.append((w.sequences[0], False))
-                continue
             if host_fail[b] or length < 0:
                 code = code_map.get(int(mout[b, 2]), -1)
                 with self._reject_lock:

@@ -69,10 +69,13 @@ FAIL_PATH = 5
 
 def available() -> bool:
     """True when the on-device POA path should be used: a real TPU
-    backend (the CPU mesh used for the multichip dryrun keeps the
-    portable lax.scan lockstep engine) and not explicitly disabled."""
+    backend, or any backend with interpret mode forced (the multichip
+    dryrun and the sharding tests set RACON_TPU_PALLAS_INTERPRET=1 so
+    the production dispatch path is exercised without TPU hardware)."""
     if os.environ.get("RACON_TPU_NO_PALLAS"):
         return False
+    if os.environ.get("RACON_TPU_PALLAS_INTERPRET") == "1":
+        return True
     try:
         return jax.devices()[0].platform == "tpu"
     except Exception:
@@ -767,11 +770,11 @@ def _kernel(nlay_ref, bblen_ref,
 
 @functools.partial(
     jax.jit,
-    static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17))
+    static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18))
 def _poa_full(seqs, wts, meta, nlay, bblen,
               v: int, lp: int, d1: int, p: int, s_: int, a_: int,
               k: int, wb: int, match: int, mismatch: int, gap: int,
-              wtype: int, trim: int):
+              wtype: int, trim: int, interpret: bool = False):
     """seqs/wts: [B, D1, LP] uint8 (d=0 = backbone), meta: [B, D1, 8]
     int32 (begin, end, full_span, slen, ...), nlay/bblen: [B] int32.
     Returns (cons [B, V, 1] int32, mout [B, 8, 1] int32)."""
@@ -839,23 +842,78 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
         grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct((b, v, 1), jnp.int32),
                    jax.ShapeDtypeStruct((b, 8, 1), jnp.int32)),
+        interpret=interpret,
     )(nlay, bblen, seqs_l, wts_l, meta)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "v", "lp", "d1", "p", "s_", "a_", "k",
+                     "wb", "match", "mismatch", "gap", "wtype", "trim",
+                     "interpret"))
+def _poa_full_sharded(seqs, wts, meta, nlay, bblen, *, mesh,
+                      v, lp, d1, p, s_, a_, k, wb,
+                      match, mismatch, gap, wtype, trim, interpret):
+    """The same kernel sharded over the mesh batch axis with shard_map:
+    one compile, XLA places one grid per device, no collectives — the
+    TPU-native analog of the reference's fully independent per-device
+    batch queues (src/cuda/cudapolisher.cpp:231-243)."""
+    from racon_tpu.parallel.mesh_utils import shard_batch_map
+
+    def shard_fn(seqs, wts, meta, nlay, bblen):
+        return _poa_full(seqs, wts, meta, nlay, bblen,
+                         v, lp, d1, p, s_, a_, k, wb,
+                         match, mismatch, gap, wtype, trim, interpret)
+
+    return shard_batch_map(shard_fn, mesh, 5, 2)(
+        seqs, wts, meta, nlay, bblen)
 
 
 def poa_full_batch(seqs, wts, meta, nlay, bblen, *,
                    v, lp, d1, p=16, s=16, a=8, k=128, wb=256,
-                   match=5, mismatch=-4, gap=-8, wtype=1, trim=1):
+                   match=5, mismatch=-4, gap=-8, wtype=1, trim=1,
+                   mesh=None):
     """NumPy-facing wrapper.  Returns (cons_chars [B, V] int32 np,
     mout [B, 8] int32 np).  mout rows: 0 length (-1 = failed ->
     CPU re-polish), 1 status (2 = chimeric warning), 2 fail code,
-    3 nodes used, 4 total DP rank steps (for cells accounting)."""
-    cons, mout = _poa_full(
-        jnp.asarray(seqs), jnp.asarray(wts), jnp.asarray(meta),
-        jnp.asarray(nlay), jnp.asarray(bblen),
-        v, lp, d1, p, s, a, k, wb, match, mismatch, gap, wtype, trim)
+    3 nodes used, 4 total DP rank steps (for cells accounting).
+
+    With a multi-device ``mesh`` the batch axis is sharded across the
+    devices (callers pad the batch; this pads further to a mesh
+    multiple with inert 1-base windows)."""
+    from racon_tpu.parallel.mesh_utils import interpret_mode
+
+    n_dev = len(mesh.devices) if mesh is not None else 1
+    interp = interpret_mode()
+    b0 = seqs.shape[0]
+    if n_dev > 1:
+        rem = (-b0) % n_dev
+        if rem:
+            seqs = np.concatenate(
+                [seqs, np.zeros((rem,) + seqs.shape[1:], seqs.dtype)])
+            seqs[b0:, 0, 0] = ord("A")
+            wts = np.concatenate(
+                [wts, np.ones((rem,) + wts.shape[1:], wts.dtype)])
+            meta = np.concatenate(
+                [meta, np.zeros((rem,) + meta.shape[1:], meta.dtype)])
+            nlay = np.concatenate([nlay, np.zeros(rem, nlay.dtype)])
+            bblen = np.concatenate([bblen, np.ones(rem, bblen.dtype)])
+        cons, mout = _poa_full_sharded(
+            jnp.asarray(seqs), jnp.asarray(wts), jnp.asarray(meta),
+            jnp.asarray(nlay), jnp.asarray(bblen), mesh=mesh,
+            v=v, lp=lp, d1=d1, p=p, s_=s, a_=a, k=k, wb=wb,
+            match=match, mismatch=mismatch, gap=gap, wtype=wtype,
+            trim=trim, interpret=interp)
+    else:
+        cons, mout = _poa_full(
+            jnp.asarray(seqs), jnp.asarray(wts), jnp.asarray(meta),
+            jnp.asarray(nlay), jnp.asarray(bblen),
+            v, lp, d1, p, s, a, k, wb, match, mismatch, gap, wtype,
+            trim, interp)
     # start both device->host copies before blocking on either: the
     # tunnel's per-transfer latency dominates, so pipelining them
     # saves one round trip
     cons.copy_to_host_async()
     mout.copy_to_host_async()
-    return np.asarray(cons)[:, :, 0], np.asarray(mout)[:, :, 0]
+    # slice off any mesh-multiple pad rows: the contract is [B, ...]
+    return np.asarray(cons)[:b0, :, 0], np.asarray(mout)[:b0, :, 0]

@@ -146,16 +146,17 @@ class TPUPolisher(Polisher):
         n_dev = len(self.mesh.devices)
         batch_size = self._poa_batch_size(vcap, lcap, n_dev)
         # the full-device engine uploads B x depth x lcap bytes per
-        # megabatch; cap B so one upload stays ~10 MB on big runs
+        # megabatch; cap B so one upload stays ~10 MB per device
         batch_size = min(batch_size,
-                         _env_int("RACON_TPU_POA_MEGABATCH", 256))
+                         n_dev * _env_int("RACON_TPU_POA_MEGABATCH",
+                                          256))
         # -b narrows the POA band (cudapoa banded analog); default is
         # the auto band (l_b/4, floor 256)
         engine = TPUPoaBatchEngine(
             self.match, self.mismatch, self.gap, vcap=vcap, pcap=16,
             lcap=lcap, kcap=128, max_depth=self.MAX_DEPTH_PER_WINDOW,
             band_cols=128 if self.tpu_banded_alignment else 0,
-            mesh=self.mesh if n_dev > 1 else None)
+            mesh=self.mesh)
 
         # trivial windows (<3 sequences) keep the backbone and count as
         # unpolished (window.cpp:68-71); the rest go to the device in
@@ -465,7 +466,7 @@ class TPUPolisher(Polisher):
                 continue
             moves, lens, dists = align_pallas.align_batch(
                 [queries[i] for i in idx], [targets[i] for i in idx],
-                bd, bd, wb)
+                bd, bd, wb, mesh=self.mesh)
             self.align_cells += sum(len(queries[i]) for i in idx) * wb
             still = set()
             for k, i in enumerate(idx):
