@@ -9,12 +9,12 @@ latency each.  This kernel aligns EVERY queued pair in one
 
 Design notes:
 
-* **4 pairs per grid program, stacked on the sublane axis**: the
-  banded row DP's critical path is the in-row prefix-min chain
-  (log2(wb) serial vector steps, latency-bound regardless of width),
-  so four independent pairs share ONE chain per row group -- ~3x the
-  single-pair throughput.  Callers sort pairs by length so group
-  partners finish together;
+* **8 pairs per grid program, stacked on the sublane axis** (a full
+  8-sublane vreg): the banded row DP's critical path is the in-row
+  prefix-min chain (log2(wb) serial vector steps, latency-bound
+  regardless of width), so eight independent pairs share ONE chain
+  per row group -- measured 0.57-0.96 us/row vs ~2 us single-pair.
+  Callers sort pairs by length so group partners finish together;
 * the row loop bound is the group's longest REAL query, so mixing
   short and long pairs in one shape bucket costs padding memory, not
   padded compute -- no per-length bucket dispatch loop (the
@@ -29,7 +29,7 @@ Design notes:
 * no direction tape is materialised in HBM: the forward pass keeps
   one score-row checkpoint every ``_CKPT`` rows in VMEM, and the
   traceback re-derives each 128-row block's directions from its
-  checkpoint on demand, walking all four pairs' segments through a
+  checkpoint on demand, walking all stacked pairs' segments through a
   block before moving down (one recompute per block, not per pair);
 * the kernel emits 2-bit moves (diag/up/left) packed 16-per-int32;
   the host reconstructs =/X from the sequences vectorised, then RLEs
@@ -56,17 +56,21 @@ _CKPT = 128                  # rows between score checkpoints
 
 
 def _ckrows(wb: int) -> int:
+    """Rows per checkpoint block, shrunk for wide bands so the dirs
+    scratch (ckrows x 8 x wb i32) stays inside the ~16 MB VMEM scope."""
+    if wb >= 8192:
+        return 32
     return 64 if wb >= 4096 else _CKPT
 _N_SHIFT = 3                 # band start advances <= 2 quanta per row
-_S = 4                       # pairs stacked per grid program
+_S = 8                       # pairs stacked per grid program
 _MV_DIAG, _MV_UP, _MV_LEFT = 0, 1, 2
 
 
 def available() -> bool:
     """Default on real TPU backends (RACON_TPU_PALLAS_ALIGN=0 falls
-    back to the scan-ladder kernels): with 4 pairs sharing each row
-    group the kernel measures ~1.2 us/row including the traceback
-    pass, ~3x the scan ladder, in ONE dispatch per band rung."""
+    back to the scan-ladder kernels): with 8 pairs sharing each row
+    group the kernel measures 0.57-0.96 us/row including the
+    traceback pass, in ONE dispatch per band rung."""
     if os.environ.get("RACON_TPU_NO_PALLAS"):
         return False
     if os.environ.get("RACON_TPU_PALLAS_ALIGN", "1") == "0":
@@ -164,10 +168,10 @@ def _kernel(ql_ref, tl_ref, q_ref, t_ref, tape_ref, dist_ref,
                        constant_values=big)
 
     # ---- pass 1: forward scores, checkpoints every _CKPT rows -------
-    def ck_save(slot, rows4):
-        # tiled HBM slices must be 8-row aligned AND 8 rows long, so
-        # the staging buffer carries 4 live + 4 dead rows
-        ckstage[0:_S, :] = rows4
+    def ck_save(slot, rows):
+        # tiled HBM slices must be 8-row aligned AND 8 rows long --
+        # exactly one _S=8 row group per checkpoint slot
+        ckstage[0:_S, :] = rows
         cp = pltpu.make_async_copy(
             ckstage,
             ckpt_hbm.at[pl.ds(pl.multiple_of(ck0 + slot * 8, 8),

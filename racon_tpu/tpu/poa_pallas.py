@@ -887,17 +887,16 @@ def poa_full_batch(seqs, wts, meta, nlay, bblen, *,
     interp = interpret_mode()
     b0 = seqs.shape[0]
     if n_dev > 1:
-        rem = (-b0) % n_dev
-        if rem:
-            seqs = np.concatenate(
-                [seqs, np.zeros((rem,) + seqs.shape[1:], seqs.dtype)])
+        if b0 % n_dev:
+            from racon_tpu.parallel.mesh_utils import pad_to_multiple
+
+            # inert pad windows: 1-base 'A' backbone, no layers
+            seqs = pad_to_multiple(seqs, n_dev, 0)
             seqs[b0:, 0, 0] = ord("A")
-            wts = np.concatenate(
-                [wts, np.ones((rem,) + wts.shape[1:], wts.dtype)])
-            meta = np.concatenate(
-                [meta, np.zeros((rem,) + meta.shape[1:], meta.dtype)])
-            nlay = np.concatenate([nlay, np.zeros(rem, nlay.dtype)])
-            bblen = np.concatenate([bblen, np.ones(rem, bblen.dtype)])
+            wts = pad_to_multiple(wts, n_dev, 1)
+            meta = pad_to_multiple(meta, n_dev, 0)
+            nlay = pad_to_multiple(nlay, n_dev, 0)
+            bblen = pad_to_multiple(bblen, n_dev, 1)
         cons, mout = _poa_full_sharded(
             jnp.asarray(seqs), jnp.asarray(wts), jnp.asarray(meta),
             jnp.asarray(nlay), jnp.asarray(bblen), mesh=mesh,

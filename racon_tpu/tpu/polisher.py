@@ -187,7 +187,7 @@ class TPUPolisher(Polisher):
         from collections import deque
 
         lock = threading.Lock()
-        n_workers = max(1, self._pool._max_workers - 1)
+        n_workers = max(0, self.num_threads - 1)
         if os.environ.get("RACON_TPU_POA_DEVICE_ONLY"):
             n_workers = 0
         steal = bool(os.environ.get("RACON_TPU_STEAL")) and n_workers
@@ -287,8 +287,15 @@ class TPUPolisher(Polisher):
         from racon_tpu.utils.tuning import pow2_at_least
         return pow2_at_least(n, 512)
 
+    # measured r3 engine rates backing the deterministic hybrid split:
+    # the 8-stacked Pallas kernel runs 0.57-0.96 us/row including the
+    # traceback pass (band 2048-8192); CPU WFA on sample-divergence
+    # overlaps costs ~4 ns x dim^2 (O(N + D^2) with D ~ 20-35% of N)
+    DEV_NS_PER_ROW = 1100
+    CPU_NS_PER_CELL = 4.0
+
     def _device_align_overlaps(self, overlaps: List[Overlap]) -> None:
-        pending = []  # (bucket_lq, bucket_lt, overlap)
+        pending = []  # (dim, overlap), dim = max span side
         for o in overlaps:
             if o.cigar or o.breaking_points is not None:
                 continue
@@ -296,59 +303,110 @@ class TPUPolisher(Polisher):
             lt = o.t_end - o.t_begin
             if max(lq, lt) > self.max_align_dim or min(lq, lt) == 0:
                 continue  # CPU fallback
-            # square buckets (max dim): with banded DP the padding on
-            # the smaller dim costs only extra scan steps, and merging
-            # asymmetric shapes avoids tiny batches each paying a full
-            # wavefront dispatch + its own compiled variant
-            bd = self._bucket_dim(max(lq, lt))
-            pending.append((bd, bd, o))
+            pending.append((max(lq, lt), o))
         if not pending:
             return
+        pending.sort(key=lambda x: -x[0])
+        from racon_tpu.tpu import align_pallas as _ap
+        if _ap.available():
+            self._hybrid_pallas_align(pending)
+        else:
+            self._hybrid_scan_align(pending)
 
-        # hybrid work-stealing, like the POA stage: the device consumes
-        # same-bucket runs from the large end of the queue while CPU
-        # WFA workers steal small overlaps from the other end (device
-        # dispatches release the GIL while blocking).  A stolen overlap
-        # gets the full base-class treatment (CIGAR + breaking points),
-        # so the fall-through pass skips it.
+    def _hybrid_pallas_align(self, pending) -> None:
+        """Stacked-kernel-first hybrid: the device owns a prefix of
+        the length-sorted queue (one dispatch per band rung, all
+        shapes in one bucket since the kernel's row loops follow real
+        lengths), while CPU WFA workers drain the small tail
+        concurrently.  The cut is a deterministic rate-model argmin —
+        a pure function of the input, so repeated runs emit
+        byte-identical output (the engines resolve cost ties
+        differently, so assignment must not depend on timing).
+        RACON_TPU_ALIGN_SPLIT overrides the cut; RACON_TPU_STEAL only
+        affects the scan/POA hybrid loops (this path dispatches the
+        whole device share at once, so there is nothing to steal)."""
         import threading
         from collections import deque
 
         from racon_tpu.ops import cpu as cpu_ops
 
-        pending.sort(key=lambda x: -x[0])
+        n_workers = max(0, self.num_threads - 1)
+        if os.environ.get("RACON_TPU_ALIGN_DEVICE_ONLY"):
+            n_workers = 0
+        dims = [d for d, _ in pending]
+        n_dev = len(self.mesh.devices)
+        if not n_workers:
+            cut = len(pending)
+        elif "RACON_TPU_ALIGN_SPLIT" in os.environ:
+            # manual device-share override (fraction of dim weight)
+            cut = _split_cut(
+                dims, float(os.environ["RACON_TPU_ALIGN_SPLIT"]))
+        else:
+            dev_pre = [0]
+            for d in dims:
+                dev_pre.append(
+                    dev_pre[-1] + d * self.DEV_NS_PER_ROW / n_dev)
+            best, cut = None, len(pending)
+            suf = sum(self.CPU_NS_PER_CELL * d * d for d in dims)
+            for k in range(len(pending) + 1):
+                if k:
+                    suf -= self.CPU_NS_PER_CELL * dims[k - 1] ** 2
+                t = max(dev_pre[k], suf / n_workers)
+                if best is None or t < best:
+                    best, cut = t, k
 
-        n_workers = max(1, self._pool._max_workers - 1)
+        work = deque(pending[cut:])
+        lock = threading.Lock()
+        n_cpu_done = 0
+
+        def cpu_worker():
+            nonlocal n_cpu_done
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    _, o = work.pop()
+                    n_cpu_done += 1
+                o.find_breaking_points(self.sequences,
+                                       self.window_length,
+                                       aligner=cpu_ops.align)
+
+        workers = [self._pool.submit(cpu_worker)
+                   for _ in range(n_workers)]
+        if cut:
+            self._pallas_align([o for _, o in pending[:cut]])
+        for f in workers:
+            f.result()
+        if n_cpu_done:
+            self.logger.log(
+                f"[racon_tpu::TPUPolisher::align] cpu-aligned "
+                f"{n_cpu_done} overlaps concurrently")
+
+    def _hybrid_scan_align(self, pending) -> None:
+        """Scan-ladder hybrid for backends without the Pallas kernel:
+        the device consumes same-bucket runs from the large end of the
+        queue while CPU WFA workers take the small-bucket tail (device
+        dispatches release the GIL while blocking).  A CPU-taken
+        overlap gets the full base-class treatment (CIGAR + breaking
+        points), so the fall-through pass skips it."""
+        import threading
+        from collections import deque
+
+        from racon_tpu.ops import cpu as cpu_ops
+
+        # square power-of-two buckets (max dim): with banded DP the
+        # padding on the smaller dim costs only extra scan steps, and
+        # merging asymmetric shapes avoids tiny batches each paying a
+        # full wavefront dispatch + its own compiled variant
+        pending = [(self._bucket_dim(d), o) for d, o in pending]
+
+        n_workers = max(0, self.num_threads - 1)
         if os.environ.get("RACON_TPU_ALIGN_DEVICE_ONLY"):
             n_workers = 0
         steal = bool(os.environ.get("RACON_TPU_STEAL")) and n_workers
         work = deque(pending)
-        from racon_tpu.tpu import align_pallas as _ap
         if steal or not n_workers:
             dev_left = len(pending)
-        elif _ap.available() and "RACON_TPU_ALIGN_SPLIT" not in \
-                os.environ:
-            # deterministic rate-model boundary: the stacked kernel's
-            # cost is ~linear in pair length (~1.2 us/row) while the
-            # CPU WFA's is ~quadratic (O(N + D^2), D ~ 15-20% of N);
-            # pick the cut minimizing the slower engine's predicted
-            # time.  Pure function of the input -> byte-reproducible.
-            dims = [p[0] for p in pending]
-            dev_pre = [0]
-            for d in dims:
-                # stacked kernel handles >=8192 buckets (~1.2 us/row);
-                # smaller pairs run the ~3x-slower scan ladder
-                rate = 1200 if d >= 8192 else 3600
-                dev_pre.append(dev_pre[-1] + d * rate)       # ns
-            cpu_total = sum(d * d for d in dims)
-            best, dev_left = None, len(pending)
-            cpu_suf = cpu_total
-            for k in range(len(pending) + 1):
-                if k:
-                    cpu_suf -= dims[k - 1] * dims[k - 1]
-                t = max(dev_pre[k], cpu_suf / max(1, n_workers))
-                if best is None or t < best:
-                    best, dev_left = t, k
         else:
             # deterministic static boundary (see the POA stage): the
             # CPU owns the small-bucket tail past the cut
@@ -356,19 +414,6 @@ class TPUPolisher(Polisher):
                 [p[0] for p in pending],
                 float(os.environ.get("RACON_TPU_ALIGN_SPLIT",
                                      "0.5")))
-        # the stacked Pallas kernel clears FEW BIG pairs ~3x faster
-        # than the scan ladder (one dispatch, dynamic row loops), but
-        # the batched scan kernels win on MANY SMALL pairs (hundreds
-        # of lanes amortize each scan step) -- route by bucket size,
-        # peeling big pairs off the device-owned prefix
-        pallas_big = []
-        if _ap.available():
-            region = len(work) if steal or not n_workers else dev_left
-            nbig = 0
-            while work and nbig < region and work[0][0] >= 8192:
-                pallas_big.append(work.popleft()[2])
-                nbig += 1
-            dev_left = max(0, dev_left - nbig)
 
         lock = threading.Lock()
         n_cpu_done = 0
@@ -379,7 +424,7 @@ class TPUPolisher(Polisher):
                 with lock:
                     if len(work) <= (0 if steal else dev_left):
                         return
-                    _, _, o = work.pop()
+                    _, o = work.pop()
                     n_cpu_done += 1
                 o.find_breaking_points(self.sequences,
                                        self.window_length,
@@ -387,12 +432,6 @@ class TPUPolisher(Polisher):
 
         workers = [self._pool.submit(cpu_worker)
                    for _ in range(n_workers)]
-
-        if pallas_big:
-            self._pallas_align(pallas_big)
-            self.logger.log(
-                f"[racon_tpu::TPUPolisher::align] device-aligned "
-                f"{len(pallas_big)} large overlaps (stacked kernel)")
 
         n_dev = len(self.mesh.devices)
         n_done = 0
@@ -402,9 +441,8 @@ class TPUPolisher(Polisher):
                                                     dev_left)
                 if limit <= 0:
                     break
-                blq, blt, _ = work[0]
-                bytes_per_lane = (blq + blt) * \
-                    ((min(2048, blt) + 5) // 4)
+                bd = work[0][0]
+                bytes_per_lane = 2 * bd * ((min(2048, bd) + 5) // 4)
                 max_b = max(n_dev, int(self.align_mem_budget
                                        // bytes_per_lane))
                 max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
@@ -412,14 +450,14 @@ class TPUPolisher(Polisher):
                     max_b = min(max_b, max(8, (limit + 1) // 2))
                 chunk = []
                 while work and len(chunk) < min(max_b, limit) \
-                        and work[0][:2] == (blq, blt):
-                    chunk.append(work.popleft()[2])
+                        and work[0][0] == bd:
+                    chunk.append(work.popleft()[1])
                 dev_left -= len(chunk)
-            self._align_chunk(chunk, blq, blt, n_dev)
+            self._align_chunk(chunk, bd, bd, n_dev)
             n_done += len(chunk)
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::align] device-aligned "
-                f"{n_done} overlaps (bucket {blq}x{blt})")
+                f"{n_done} overlaps (bucket {bd}x{bd})")
         for f in workers:
             f.result()
         if n_cpu_done:
@@ -449,40 +487,59 @@ class TPUPolisher(Polisher):
         # from the diagonal, so a band of wb columns (quantized 128,
         # margin wb/2 - 256 per side) certifies
         # cost + |dlen| <= wb - 512.
+        # starting rung from the expected cost: sample ONT overlaps
+        # measure 25-35% band cost relative to their dimension, so /3
+        # (a /5 estimate sent ~85% of the first rung to a retry)
         dabs = [abs(len(q) - len(t))
                 for q, t in zip(queries, targets)]
-        need = [max(dabs[i], max(len(q), len(t)) // 5)
+        need = [max(dabs[i], max(len(q), len(t)) // 3)
                 for i, (q, t) in enumerate(zip(queries, targets))]
         pending = list(range(len(overlaps)))
-        for wb in (2048, 4096):
-            if not pending or wb - 512 > 2 * bd:
+        rungs = (2048, 4096, 8192)
+        for wb in rungs:
+            if not pending:
                 break
             # the forced last rung still skips pairs that provably
             # cannot certify (distance >= dabs)
             idx = [i for i in pending
                    if need[i] + dabs[i] <= wb - 512
-                   or (wb == 4096 and 2 * dabs[i] <= wb - 512)]
+                   or (wb == rungs[-1] and 2 * dabs[i] <= wb - 512)]
             if not idx:
                 continue
-            moves, lens, dists = align_pallas.align_batch(
-                [queries[i] for i in idx], [targets[i] for i in idx],
-                bd, bd, wb, mesh=self.mesh)
-            self.align_cells += sum(len(queries[i]) for i in idx) * wb
+            # the kernel's checkpoint HBM out-buffer costs
+            # (bd/ckrows + 1) * wb * 4 bytes per pair (plus q/t/tape);
+            # chunk the dispatch so one batch stays in budget
+            per_pair = ((bd // align_pallas._ckrows(wb) + 1) * wb * 4
+                        + 6 * bd)
+            max_b = max(8 * len(self.mesh.devices),
+                        int(self.align_mem_budget // per_pair))
+            max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
+            n_cert = 0
             still = set()
-            for k, i in enumerate(idx):
-                if dists[k] + dabs[i] <= wb - 512:
-                    ops = align_pallas.moves_to_ops(
-                        moves[k], int(lens[k]), queries[i], targets[i])
-                    overlaps[i].cigar_runs = aligner.ops_to_runs(ops)
-                else:
-                    still.add(i)
+            for c0 in range(0, len(idx), max_b):
+                sub = idx[c0:c0 + max_b]
+                moves, lens, dists = align_pallas.align_batch(
+                    [queries[i] for i in sub],
+                    [targets[i] for i in sub],
+                    bd, bd, wb, mesh=self.mesh)
+                self.align_cells += sum(len(queries[i])
+                                        for i in sub) * wb
+                for k, i in enumerate(sub):
+                    if dists[k] + dabs[i] <= wb - 512:
+                        ops = align_pallas.moves_to_ops(
+                            moves[k], int(lens[k]), queries[i],
+                            targets[i])
+                        overlaps[i].cigar_runs = \
+                            aligner.ops_to_runs(ops)
+                        n_cert += 1
+                    else:
+                        still.add(i)
             idx_set = set(idx)
             pending = [i for i in pending
                        if i in still or i not in idx_set]
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::align] device-aligned "
-                f"{len(idx) - len(still)}/{len(idx)} overlaps "
-                f"(band {wb})")
+                f"{n_cert}/{len(idx)} overlaps (band {wb})")
         # survivors lack a CIGAR and take the CPU fall-through
         # (the reference's exceeded_max_alignment_difference skip)
 
