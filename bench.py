@@ -171,6 +171,12 @@ def main():
             log(f"[bench] scale bench skipped "
                 f"({type(exc).__name__}: {exc})")
 
+        try:
+            extra.update(mega_bench())
+        except Exception as exc:
+            log(f"[bench] mega bench skipped "
+                f"({type(exc).__name__}: {exc})")
+
     print(json.dumps({
         "metric": "sample_e2e_polish_wall_s",
         "value": round(accel_wall, 3),
@@ -181,6 +187,11 @@ def main():
         "cpu_edit_distance": int(cpu_dist),
         **extra,
     }))
+    if not extra.get("deterministic", True):
+        # a nondeterministic TPU path is a regression, not a footnote
+        # (the reference diffs full output byte-for-byte in CI,
+        # ci/gpu/cuda_test.sh:33) -- fail the bench run
+        sys.exit(1)
 
 
 def scale_bench():
@@ -231,6 +242,60 @@ def scale_bench():
             "scale_speedup": round(cpu_wall / tpu_wall, 3),
             "scale_tpu_edit_distance": int(d_tpu),
             "scale_cpu_edit_distance": int(d_cpu),
+        }
+
+
+def mega_bench():
+    """Megabase-scale workload (opt-in: RACON_TPU_BENCH_MEGA=1): a
+    4.6 Mb / 30x synthetic, the E. coli-class analog of the
+    reference's CI scale test (ci/gpu/cuda_test.sh:25-33, ~4.6 Mb ONT
+    polish).  This is where megabatch utilization, HBM budgeting and
+    the hybrid split get stressed; measured numbers are recorded in
+    BASELINE.md.  Off by default: the CPU reference leg alone runs for
+    several minutes."""
+    if os.environ.get("RACON_TPU_BENCH_MEGA", "0") != "1":
+        return {}
+    import tempfile
+
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.ops import cpu
+    from racon_tpu.tools import simulate
+
+    with tempfile.TemporaryDirectory(prefix="racon_mega_") as tmp:
+        reads, paf, draft = simulate.simulate(
+            tmp, genome_len=4_600_000, coverage=30, read_len=10_000,
+            seed=11)
+        truth = open(os.path.join(tmp, "genome.fasta"),
+                     "rb").read().split(b"\n")[1]
+
+        def run(poa, al):
+            pol = create_polisher(
+                reads, paf, draft, PolisherType.kC, 500, 10.0, 0.3,
+                True, 5, -4, -8, num_threads=8, tpu_poa_batches=poa,
+                tpu_aligner_batches=al)
+            t0 = time.monotonic()
+            pol.initialize()
+            out = pol.polish(True)
+            return time.monotonic() - t0, out, pol
+
+        tpu_cold, _, _ = run(1, 1)
+        tpu_wall, tpu_out, tpol = run(1, 1)
+        d_tpu = cpu.edit_distance(tpu_out[0].data, truth)
+        cpu_wall, cpu_out, _ = run(0, 0)
+        d_cpu = cpu.edit_distance(cpu_out[0].data, truth)
+        rejects = sum(tpol.poa_reject_counts.values())
+        log(f"[bench] mega (4.6Mb, 30x synthetic): CPU {cpu_wall:.1f}s"
+            f" (dist {d_cpu}), TPU {tpu_wall:.1f}s warm /"
+            f" {tpu_cold:.1f}s cold (dist {d_tpu}), speedup"
+            f" {cpu_wall / tpu_wall:.2f}x, {rejects} POA rejects")
+        return {
+            "mega_tpu_cold_s": round(tpu_cold, 3),
+            "mega_cpu_wall_s": round(cpu_wall, 3),
+            "mega_tpu_wall_s": round(tpu_wall, 3),
+            "mega_speedup": round(cpu_wall / tpu_wall, 3),
+            "mega_tpu_edit_distance": int(d_tpu),
+            "mega_cpu_edit_distance": int(d_cpu),
+            "mega_poa_rejects": int(rejects),
         }
 
 

@@ -343,7 +343,7 @@ def band_align_batch(queries: Sequence[bytes], targets: Sequence[bytes],
         # count would exhaust HBM on divergent workloads
         width = (hw + 5) // 4 if hw else (blt + 4) // 4
         per_lane = (blq + blt) * width
-        cap = max(8, int(mem_budget // per_lane))
+        cap = max(1, int(mem_budget // per_lane))
         cap = 1 << (cap.bit_length() - 1)   # pow2: padding respects it
         outs = [run_one(idx[k:k + cap], hw)
                 for k in range(0, len(idx), cap)]

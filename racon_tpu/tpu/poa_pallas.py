@@ -88,24 +88,29 @@ def fits(v: int, lp: int, d1: int, p: int, s: int, a: int,
     adjacency, lane-padded path/output refs, double-buffered input
     blocks.  Configurations over budget (e.g. -w 1000 doubles every
     cap) use the lockstep engine instead of failing to compile."""
-    bytes_ = (v * wb * 8                      # ring f32 + dirs i32
-              + v * (2 * p + 3 * s + a) * 4   # adjacency
-              + (v + lp) * 128 * 4            # packed path (lane pad)
-              + 2 * 2 * d1 * lp * 4           # seq/wts blocks x2 buf
-              + 2 * v * 128 * 4)              # cons out x2 buf
-    return bytes_ <= (13 << 20)
+    vmem = (v * wb * 8                        # ring f32 + dirs i32
+            + v * (p + 2 * s) * 4             # adjacency ids (VMEM)
+            + 8 * (lp + 256) * 4              # staged char/weight row
+            + 2 * 2 * d1 * lp * 4             # seq/wts blocks x2 buf
+            + 2 * v * 128 * 4)                # cons out x2 buf
+    # SMEM: per-node scalars + mirrors + weights + the packed path;
+    # configs past the budget fail over to the lockstep engine
+    # instead of dying in the Mosaic compiler
+    smem = (v * (p + 2 * s + a + 8 + 13) + (v + lp)) * 4
+    return vmem <= (13 << 20) and smem <= (768 << 10)
 
 
 def _kernel(nlay_ref, bblen_ref,
             seqs_ref, wts_ref, meta_ref,
             cons_ref, mout_ref,
-            preds_v, predw_v, succs_v, succw_v, succanch_v,
-            alig_v, ring_v, dirs, accs, arga, path_v,
+            preds_v, succs_v, succanch_v,
+            ring_v, dirs, accs, arga, chw_v,
             base_s, anch_s, nseq_s, nxt_s, glast_s,
-            bandq_s, pcnt_s, scnt_s, predsm_s, order_s, sinkr_s,
-            score_s, cpred_s, regs_s, *,
+            bandq_s, pcnt_s, scnt_s, predsm_s, succsm_s, order_s,
+            sinkr_s, score_s, cpred_s, predw_s, succw_s, pslot_s,
+            path_s, aligsm_s, gcnt_s, regs_s, *,
             v: int, lp: int, d1: int, p: int, s_: int, a_: int,
-            k: int, wb: int, n_sl: int,
+            k: int, wb: int,
             match: int, mismatch: int, gap: int,
             wtype: int, trim: int):
     i = pl.program_id(0)
@@ -122,8 +127,7 @@ def _kernel(nlay_ref, bblen_ref,
     colsf = cols_i.astype(jnp.float32)
     iota_p = lax.broadcasted_iota(jnp.int32, (1, p), 1)
     iota_s = lax.broadcasted_iota(jnp.int32, (1, s_), 1)
-    iota_a = lax.broadcasted_iota(jnp.int32, (1, a_), 1)
-    iota_lp = lax.broadcasted_iota(jnp.int32, (1, lp), 1)
+    iota_c128 = lax.broadcasted_iota(jnp.int32, (1, 128), 1)
     # path pack radix: entry = (node+2)*pkr + (spos+2); spos < lp and
     # node < v, so pkr must clear lp (the wrapper asserts the product
     # fits int32)
@@ -132,15 +136,27 @@ def _kernel(nlay_ref, bblen_ref,
         pkr <<= 1
 
     # ---- scratch bulk init (scratch persists across grid programs) --
+    # edge WEIGHTS (and the succ-slot -> pred-slot mirror) live in
+    # SMEM: the merge phase accumulates a weight on almost every path
+    # step, and a scalar SMEM R/W is ~20 ns where a dynamic-sublane
+    # VMEM row RMW is ~800 ns; weight slots are written at edge
+    # creation before any read, so they need no bulk init
+    iota_v0 = lax.broadcasted_iota(jnp.int32, (v, 1), 0)
+    bblm = jnp.minimum(bbl, v)
+    # backbone chain adjacency, vectorized (one column store each)
     preds_v[:, :] = jnp.full((v, p), -1, jnp.int32)
-    predw_v[:, :] = jnp.zeros((v, p), jnp.int32)
+    preds_v[:, 0:1] = jnp.where((iota_v0 > 0) & (iota_v0 < bblm),
+                                iota_v0 - 1, -1)
     succs_v[:, :] = jnp.full((v, s_), -1, jnp.int32)
-    succw_v[:, :] = jnp.zeros((v, s_), jnp.int32)
+    succs_v[:, 0:1] = jnp.where(iota_v0 < bblm - 1, iota_v0 + 1, -1)
     succanch_v[:, :] = jnp.full((v, s_), _INF32, jnp.int32)
-    alig_v[:, :] = jnp.full((v, a_), -1, jnp.int32)
+    succanch_v[:, 0:1] = jnp.where(iota_v0 < bblm - 1, iota_v0 + 1,
+                                   _INF32)
+    chw_v[:, :] = jnp.zeros((8, lp + 256), jnp.int32)
 
     def init_bandq(j, _):
         bandq_s[j] = jnp.int32(-1)
+        gcnt_s[j] = jnp.int32(0)
         return 0
 
     lax.fori_loop(0, v, init_bandq, 0)
@@ -162,24 +178,29 @@ def _kernel(nlay_ref, bblen_ref,
         return e11(jnp.min(jnp.where(mask, iota_row, width),
                            axis=1, keepdims=True))
 
-    def ext_lane(row, j):
-        """row[0, j] for dynamic j via a masked reduction (dynamic
-        lane indexing is not addressable on TPU)."""
-        return e11(jnp.sum(jnp.where(iota_lp == j, row, 0), axis=1,
-                           keepdims=True))
-
     # ---- seed the backbone chain (add_alignment with an empty path:
     # racon_tpu/native/poa_graph.hpp add_alignment initial branch) ----
-    srow0 = seqs_ref[0, 0:1, :]                 # [1, LP]
-    wrow0 = wts_ref[0, 0:1, :]
-
     @pl.when(bbl > v)
     def _():
         regs_s[0] = jnp.int32(FAIL_VCAP)
 
+    # stage char*256+weight at a STATIC sublane so the per-position
+    # window loads below have a supported addressing mode (dynamic
+    # sublane + dynamic lane in one load fails to lower) and each
+    # extraction pays ONE vector->scalar sync for both values
+    chw_v[0:1, 0:lp] = seqs_ref[0, 0:1, :] * 256 + wts_ref[0, 0:1, :]
+
+    def chw_at(j):
+        """(char, weight) at dynamic position j via a 128-lane window
+        of the staged combined row."""
+        jb = (j // 128) * 128
+        win = chw_v[0:1, pl.ds(pl.multiple_of(jb, 128), 128)]
+        x = e11(jnp.sum(jnp.where(iota_c128 == (j - jb), win, 0),
+                        axis=1, keepdims=True))
+        return x // 256, x % 256
+
     def seed(j, prev_w):
-        c = ext_lane(srow0, j)
-        w = ext_lane(wrow0, j)
+        c, w = chw_at(j)
         base_s[j] = c
         anch_s[j] = j
         nseq_s[j] = jnp.int32(1)
@@ -191,20 +212,15 @@ def _kernel(nlay_ref, bblen_ref,
         predsm_s[j * 4 + 1] = jnp.int32(-1)
         predsm_s[j * 4 + 2] = jnp.int32(-1)
         predsm_s[j * 4 + 3] = jnp.int32(-1)
+        succsm_s[j * 4] = jnp.where(j + 1 < bbl, j + 1, -1)
 
         @pl.when(j > 0)
         def _():
-            succs_v[pl.ds(j - 1, 1), 0:1] = jnp.full((1, 1), j,
-                                                     jnp.int32)
-            succw_v[pl.ds(j - 1, 1), 0:1] = jnp.full((1, 1),
-                                                     prev_w + w,
-                                                     jnp.int32)
-            succanch_v[pl.ds(j - 1, 1), 0:1] = jnp.full((1, 1), j,
-                                                        jnp.int32)
-            preds_v[pl.ds(j, 1), 0:1] = jnp.full((1, 1), j - 1,
-                                                 jnp.int32)
-            predw_v[pl.ds(j, 1), 0:1] = jnp.full((1, 1), prev_w + w,
-                                                 jnp.int32)
+            # chain ids/anchors were written vectorized above; only
+            # the data-dependent weights + slot mirror are per-node
+            succw_s[(j - 1) * s_] = prev_w + w
+            pslot_s[(j - 1) * s_] = jnp.int32(0)
+            predw_s[j * p] = prev_w + w
         return w
 
     lax.fori_loop(0, jnp.minimum(bbl, v), seed, jnp.int32(0))
@@ -239,6 +255,7 @@ def _kernel(nlay_ref, bblen_ref,
             bandq_s[nid] = jnp.int32(-1)
             pcnt_s[nid] = jnp.int32(0)
             scnt_s[nid] = jnp.int32(0)
+            gcnt_s[nid] = jnp.int32(0)
             predsm_s[nid * 4] = jnp.int32(-1)
             predsm_s[nid * 4 + 1] = jnp.int32(-1)
             predsm_s[nid * 4 + 2] = jnp.int32(-1)
@@ -253,45 +270,61 @@ def _kernel(nlay_ref, bblen_ref,
 
     def add_edge(u, t, w):
         """poa_graph.hpp add_edge: accumulate weight on an existing
-        u->t edge else append (succ side + pred-side mirror)."""
-        srow = vload(succs_v, u)
-        hit = min_idx(srow == t, s_, iota_s)
+        u->t edge else append.  The accumulate (the per-path-step hot
+        case) is pure SMEM: the hit search walks the <=4-slot succ id
+        mirror (scalar reads, no vector->scalar sync), the weight
+        bump and its pred-side mirror (located via the pslot mirror
+        recorded at edge creation) are scalar writes."""
+        sc_ = scnt_s[u]
+        found = jnp.int32(-1)
+        for tt in range(3, -1, -1):     # descending: first hit wins
+            found = jnp.where((tt < sc_) & (succsm_s[u * 4 + tt] == t),
+                              tt, found)
+
+        def deep_search(_):
+            # rare: out-degree > 4, search the full VMEM id row
+            srow = vload(succs_v, u)
+            return min_idx(srow == t, s_, iota_s)
+
+        def mirror_hit(_):
+            return jnp.where(found >= 0, found, s_)
+
+        hit = lax.cond((found < 0) & (sc_ > 4), deep_search,
+                       mirror_hit, 0)
 
         @pl.when(hit < s_)
         def _():
-            roww = vload(succw_v, u)
-            succw_v[pl.ds(u, 1), :] = jnp.where(iota_s == hit,
-                                                roww + w, roww)
-            prow = vload(preds_v, t)
-            phit = min_idx(prow == u, p, iota_p)
-            prww = vload(predw_v, t)
-            predw_v[pl.ds(t, 1), :] = jnp.where(iota_p == phit,
-                                                prww + w, prww)
+            hs = u * s_ + hit
+            succw_s[hs] = succw_s[hs] + w
+            hp = t * p + pslot_s[hs]
+            predw_s[hp] = predw_s[hp] + w
 
         @pl.when(hit >= s_)
         def _():
-            free = scnt_s[u]
+            free = sc_
             prow = vload(preds_v, t)
             pfree = pcnt_s[t]
             okk = (free < s_) & (pfree < p)
 
             @pl.when(okk)
             def _():
+                srow = vload(succs_v, u)
                 succs_v[pl.ds(u, 1), :] = jnp.where(iota_s == free, t,
                                                     srow)
-                roww = vload(succw_v, u)
-                succw_v[pl.ds(u, 1), :] = jnp.where(iota_s == free, w,
-                                                    roww)
                 rowa = vload(succanch_v, u)
                 succanch_v[pl.ds(u, 1), :] = jnp.where(
                     iota_s == free, anch_s[t], rowa)
                 preds_v[pl.ds(t, 1), :] = jnp.where(iota_p == pfree, u,
                                                     prow)
-                prww = vload(predw_v, t)
-                predw_v[pl.ds(t, 1), :] = jnp.where(iota_p == pfree, w,
-                                                    prww)
+                succw_s[u * s_ + free] = w
+                pslot_s[u * s_ + free] = pfree
+                predw_s[t * p + pfree] = w
                 scnt_s[u] = free + 1
                 pcnt_s[t] = pfree + 1
+
+                @pl.when(free < 4)
+                def _():
+                    succsm_s[u * 4 + free] = t
 
                 @pl.when(pfree < 4)
                 def _():
@@ -312,7 +345,10 @@ def _kernel(nlay_ref, bblen_ref,
             fsp = mrow[0, 2]
             m = mrow[0, 3]
             regs_s[3] = regs_s[3] + jnp.where(m > 0, 1, 0)
-            wrow_l = wts_ref[0, pl.ds(d, 1), :]     # [1, LP]
+            # stage char*256+weight once per layer: the DP band slice
+            # and the merge extraction both window into this row
+            chw_v[0:1, 0:lp] = seqs_ref[0, pl.ds(d, 1), :] * 256 \
+                + wts_ref[0, pl.ds(d, 1), :]
 
             # 1) list walk: subset ranks + per-rank sink flags
             end_eff = jnp.where(fsp > 0, _INF32 - 1, end)
@@ -350,12 +386,6 @@ def _kernel(nlay_ref, bblen_ref,
                 return jnp.clip(((r * m) // nr - (q // 2)) // q, 0,
                                 smax_q)
 
-            # u-space char table: sls[sq][c'] = seq[sq*q + c']
-            srow_l = seqs_ref[0, pl.ds(d, 1), :]       # [1, LP]
-            spadl = jnp.pad(srow_l, ((0, 0), (0, wb)),
-                            constant_values=0)
-            sls = [spadl[:, mm * q: mm * q + wb] for mm in range(n_sl)]
-
             def pred_fold(pid, sq_r):
                 """One predecessor's H row realigned to this rank's
                 band, in vert space (u[c] = H_pred[s_r + c]); the diag
@@ -389,19 +419,30 @@ def _kernel(nlay_ref, bblen_ref,
                 s_r = sq_r * q
                 node = order_s[r - 1]
                 cnt = pcnt_s[node]
-                accs[0:1, :] = jnp.full((1, wb), negf, jnp.float32)
-                arga[0:1, :] = jnp.zeros((1, wb), jnp.int32)
-                nreal = jnp.int32(0)
-                nbad = jnp.int32(0)
-                # common case: <= 4 preds, ids mirrored in SMEM so the
-                # loop never syncs vector->scalar
-                for t in range(4):
-                    pid = jnp.where(t < cnt, predsm_s[node * 4 + t],
-                                    -1)
-                    hv, nv, bad = pred_fold(pid, sq_r)
-                    acc_update(hv, t)
-                    nreal = nreal + nv
-                    nbad = nbad + jnp.where(bad, 1, 0)
+                # common case: 1 pred (chain node) -- fold slot 0
+                # unguarded straight into registers; the accs merge
+                # buffer and slots 1-3 only engage for cnt > 1
+                regs_s[8] = jnp.int32(0)       # nreal from slots 1-3
+                regs_s[9] = jnp.int32(0)       # nbad from slots 1-3
+                pid0 = jnp.where(cnt > 0, predsm_s[node * 4], -1)
+                hv0, nv0, bad0 = pred_fold(pid0, sq_r)
+
+                @pl.when(cnt > 1)
+                def _():
+                    accs[0:1, :] = hv0
+                    arga[0:1, :] = jnp.zeros((1, wb), jnp.int32)
+                    for t in range(1, 4):
+                        @pl.when(cnt > t)
+                        def _(t=t):
+                            pid = predsm_s[node * 4 + t]
+                            hv, nv, bad = pred_fold(pid, sq_r)
+                            acc_update(hv, t)
+                            regs_s[8] = regs_s[8] + nv
+                            regs_s[9] = regs_s[9] + \
+                                jnp.where(bad, 1, 0)
+
+                nreal = nv0 + regs_s[8]
+                nbad = jnp.where(bad0, 1, 0) + regs_s[9]
 
                 @pl.when(nbad > 0)
                 def _():
@@ -438,12 +479,16 @@ def _kernel(nlay_ref, bblen_ref,
                 # space the virtual row is exactly (s_r + c) * gap
                 novel = nreal == 0
                 vv = (s_r + cols_i).astype(jnp.float32) * gapf
-                accu = jnp.where(novel, vv, accs[0:1, :])
-                argu = jnp.where(novel, 0, arga[0:1, :])
+                multi = cnt > 1
+                accu = jnp.where(novel, vv,
+                                 jnp.where(multi, accs[0:1, :], hv0))
+                argu = jnp.where(novel | jnp.logical_not(multi), 0,
+                                 arga[0:1, :])
 
-                sb = sls[0]
-                for mm in range(1, n_sl):
-                    sb = jnp.where(sq_r == mm, sls[mm], sb)
+                # this band's seq chars: one 128-aligned window load
+                # of the staged row (replaces a multi-way slice select)
+                sb = chw_v[0:1,
+                           pl.ds(pl.multiple_of(s_r, q), wb)] // 256
                 base_r = base_s[node]
                 # sub in u space: scored char at column c'+1 = seq
                 # position s_r + c'
@@ -507,7 +552,7 @@ def _kernel(nlay_ref, bblen_ref,
             best_node = regs_s[6]
 
 
-            # 3) traceback -> reversed path in path_v, packed as
+            # 3) traceback -> reversed path in path_s, packed as
             # (node+2)*pkr + (spos+2); node -1 = no node (horiz),
             # carried node -1 = virtual start row
             def tb_cond(c):
@@ -527,15 +572,21 @@ def _kernel(nlay_ref, bblen_ref,
                 take = is_diag | is_vert
                 slot = jnp.clip(jnp.where(is_diag, code, code - p),
                                 0, p - 1)
-                prow = vload(preds_v, nodec)
-                pid = jnp.sum(jnp.where(iota_p == slot, prow, 0))
+
+                def mirror(_):
+                    return predsm_s[nodec * 4 + jnp.clip(slot, 0, 3)]
+
+                def deep(_):
+                    prow = vload(preds_v, nodec)
+                    return jnp.sum(jnp.where(iota_p == slot, prow, 0))
+
+                pid = lax.cond(slot < 4, mirror, deep, 0)
                 pvalid = (pid >= 0) & \
                     ((bandq_s[jnp.maximum(pid, 0)] >> 8) == d)
                 pnode = jnp.where(pvalid, pid, -1)
                 en = jnp.where(take, node, -1)
                 es = jnp.where(is_vert, -1, j - 1)
-                path_v[pl.ds(step, 1), 0:1] = jnp.full(
-                    (1, 1), (en + 2) * pkr + (es + 2), jnp.int32)
+                path_s[step] = (en + 2) * pkr + (es + 2)
                 nn = jnp.where(take, pnode, node)
                 nj = jnp.where(is_vert, j, jnp.maximum(j - 1, 0))
                 return nn, nj, step + 1
@@ -548,18 +599,26 @@ def _kernel(nlay_ref, bblen_ref,
                 regs_s[0] = jnp.int32(FAIL_PATH)
 
             # 4) merge (poa_graph.hpp add_alignment), walking the
-            # reversed path backward = forward order
+            # reversed path backward = forward order; chars/weights
+            # come from the row staged at layer start
             def merge(t, carry):
+                # flattened per-step control flow: the dominant case
+                # (match into an existing same-base node) runs with
+                # ONE vector->scalar sync (the char extraction) and
+                # no lax.cond; rare cases (insertion, mismatch into
+                # an aligned group) sit behind one pl.when
                 prev, prev_w = carry
-                idx = plen - 1 - t
-                packed = e11(path_v[pl.ds(idx, 1), 0:1])
+                packed = path_s[plen - 1 - t]
                 nid = packed // pkr - 2
                 j = packed % pkr - 2
+                has = j >= 0
+                c, w = chw_at(jnp.maximum(j, 0))
+                fast = has & (nid >= 0) & \
+                    (base_s[jnp.maximum(nid, 0)] == c)
+                regs_s[10] = nid        # resolved target (fast case)
 
-                def with_char(_):
-                    c = ext_lane(srow_l, j)
-                    w = ext_lane(wrow_l, j)
-
+                @pl.when(has & jnp.logical_not(fast))
+                def _slow():
                     def t_new(_):
                         anchor = jnp.where(
                             prev < 0, begin,
@@ -569,85 +628,79 @@ def _kernel(nlay_ref, bblen_ref,
                             glast_s[jnp.maximum(prev, 0)])
                         return new_node(c, anchor, pos)
 
-                    def t_existing(_):
-                        def t_same(_):
-                            return nid
+                    def t_aligned(_):
+                        # mismatch: reuse an aligned sibling with the
+                        # same base else create one (poa_graph.hpp
+                        # aligned-group branch); groups are SMEM
+                        # count+id lists, so the search is scalar
+                        gc = gcnt_s[nid]
+                        found = jnp.int32(-1)
+                        for aa in range(a_ - 1, -1, -1):
+                            # slots >= gc hold stale garbage; clamp
+                            # before indexing base_s (OOB SMEM reads
+                            # are UB on hardware even when masked out)
+                            sib = jnp.clip(aligsm_s[nid * a_ + aa],
+                                           0, v - 1)
+                            okb = (aa < gc) & (base_s[sib] == c)
+                            found = jnp.where(okb, sib, found)
 
-                        def t_aligned(_):
-                            # mismatch: reuse an aligned sibling with
-                            # the same base else create one
-                            # (poa_graph.hpp aligned-group branch)
-                            arow = vload(alig_v, nid)
-                            found = jnp.int32(-1)
-                            for aa in range(a_):
-                                sib = arow[0, aa]
-                                okb = (sib >= 0) & (found < 0) & \
-                                    (base_s[jnp.maximum(sib, 0)] == c)
-                                found = jnp.where(okb, sib, found)
+                        def mk_new(_):
+                            tgt = new_node(c, anch_s[nid],
+                                           glast_s[nid])
 
-                            def mk_new(_):
-                                tgt = new_node(c, anch_s[nid],
-                                               glast_s[nid])
-                                nslot = min_idx(arow < 0, a_, iota_a)
-                                grp_ok = nslot < a_
+                            @pl.when(gc >= a_)
+                            def _():
+                                regs_s[0] = jnp.int32(FAIL_ALIGNED)
 
-                                @pl.when(jnp.logical_not(grp_ok))
-                                def _():
-                                    regs_s[0] = jnp.int32(FAIL_ALIGNED)
+                            @pl.when(gc < a_)
+                            def _():
+                                # tgt's group = nid's members + nid
+                                def cp(aa, _):
+                                    aligsm_s[tgt * a_ + aa] = \
+                                        aligsm_s[nid * a_ + aa]
+                                    return 0
 
-                                @pl.when(grp_ok)
-                                def _():
-                                    # new node's group = arow + nid
-                                    trow = jnp.where(iota_a == nslot,
-                                                     nid, arow)
-                                    alig_v[pl.ds(tgt, 1), :] = trow
-                                    # append tgt to each member + nid
-                                    for aa in range(a_):
-                                        sib = arow[0, aa]
+                                lax.fori_loop(0, gc, cp, 0)
+                                aligsm_s[tgt * a_ + gc] = nid
+                                gcnt_s[tgt] = gc + 1
 
-                                        @pl.when(sib >= 0)
-                                        def _(sib=sib):
-                                            sr = vload(alig_v, sib)
-                                            fs = min_idx(sr < 0, a_,
-                                                         iota_a)
-                                            alig_v[pl.ds(sib, 1),
-                                                   :] = jnp.where(
-                                                iota_a == fs, tgt, sr)
-                                            glast_s[sib] = tgt
-                                    nrow2 = vload(alig_v, nid)
-                                    fs2 = min_idx(nrow2 < 0, a_,
-                                                  iota_a)
+                                # append tgt to each member (groups
+                                # already full skip the append, like
+                                # the full-row no-op store before)
+                                def ap(aa, _):
+                                    sib = aligsm_s[nid * a_ + aa]
+                                    gs = gcnt_s[sib]
 
-                                    @pl.when(fs2 >= a_)
+                                    @pl.when(gs < a_)
                                     def _():
-                                        regs_s[0] = jnp.int32(
-                                            FAIL_ALIGNED)
+                                        aligsm_s[sib * a_ + gs] = tgt
+                                        gcnt_s[sib] = gs + 1
+                                    glast_s[sib] = tgt
+                                    return 0
 
-                                    @pl.when(fs2 < a_)
-                                    def _():
-                                        alig_v[pl.ds(nid, 1),
-                                               :] = jnp.where(
-                                            iota_a == fs2, tgt, nrow2)
-                                    glast_s[nid] = tgt
-                                return tgt
+                                lax.fori_loop(0, gc, ap, 0)
+                                aligsm_s[nid * a_ + gc] = tgt
+                                gcnt_s[nid] = gc + 1
+                                glast_s[nid] = tgt
+                            return tgt
 
-                            return lax.cond(found >= 0,
-                                            lambda _: found,
-                                            mk_new, 0)
+                        return lax.cond(found >= 0, lambda _: found,
+                                        mk_new, 0)
 
-                        return lax.cond(base_s[nid] == c, t_same,
-                                        t_aligned, 0)
+                    regs_s[10] = lax.cond(nid < 0, t_new, t_aligned, 0)
 
-                    target = lax.cond(nid < 0, t_new, t_existing, 0)
+                target = regs_s[10]
+
+                @pl.when(has)
+                def _():
                     nseq_s[target] = nseq_s[target] + 1
 
                     @pl.when(prev >= 0)
                     def _():
                         add_edge(prev, target, prev_w + w)
-                    return target, w
 
-                return lax.cond(j >= 0, with_char,
-                                lambda _: (prev, prev_w), 0)
+                return (jnp.where(has, target, prev),
+                        jnp.where(has, w, prev_w))
 
             lax.fori_loop(0, plen, merge,
                           (jnp.int32(-1), jnp.int32(0)))
@@ -682,23 +735,35 @@ def _kernel(nlay_ref, bblen_ref,
 
         # forward DP: per node pick the heaviest in-edge (ties ->
         # higher predecessor score; slot order = insertion order,
-        # matching poa_graph.hpp consensus_path)
+        # matching poa_graph.hpp consensus_path).  Ids come from the
+        # SMEM mirror for the common <=4-pred case, weights from SMEM.
         def cdp(r, best_sink):
             node = order_s[r]
-            prow = vload(preds_v, node)
-            wrow = vload(predw_v, node)
-            best_w = jnp.int32(-1)
-            best_u = jnp.int32(-1)
-            for pp in range(p):
-                pid = prow[0, pp]
-                w = wrow[0, pp]
+            cnt = pcnt_s[node]
+
+            def pick(t, carry):
+                bu, bw = carry
+
+                def mirror(_):
+                    return predsm_s[node * 4 + jnp.clip(t, 0, 3)]
+
+                def deep(_):
+                    prow = vload(preds_v, node)
+                    return e11(jnp.sum(
+                        jnp.where(iota_p == t, prow, 0), axis=1,
+                        keepdims=True))
+
+                pid = lax.cond(t < 4, mirror, deep, 0)
+                w = predw_s[node * p + t]
                 sc = score_s[jnp.maximum(pid, 0)]
-                bsc = score_s[jnp.maximum(best_u, 0)]
-                tk = (pid >= 0) & ((w > best_w) |
-                                   ((w == best_w) & (best_u >= 0) &
+                bsc = score_s[jnp.maximum(bu, 0)]
+                tk = (pid >= 0) & ((w > bw) |
+                                   ((w == bw) & (bu >= 0) &
                                     (sc > bsc)))
-                best_u = jnp.where(tk, pid, best_u)
-                best_w = jnp.where(tk, w, best_w)
+                return (jnp.where(tk, pid, bu), jnp.where(tk, w, bw))
+
+            best_u, best_w = lax.fori_loop(
+                0, cnt, pick, (jnp.int32(-1), jnp.int32(-1)))
             score_s[node] = jnp.where(
                 best_u >= 0,
                 score_s[jnp.maximum(best_u, 0)] + best_w, 0)
@@ -719,8 +784,7 @@ def _kernel(nlay_ref, bblen_ref,
 
         def bbody(c):
             node, ln = c
-            path_v[pl.ds(ln, 1), 0:1] = jnp.full(
-                (1, 1), (node + 2) * pkr + 2, jnp.int32)
+            path_s[ln] = (node + 2) * pkr + 2
             return cpred_s[node], ln + 1
 
         _, clen = lax.while_loop(bcond, bbody,
@@ -730,14 +794,13 @@ def _kernel(nlay_ref, bblen_ref,
         avg = (regs_s[3] - 1) // 2
 
         def scan_fwd(t, first):
-            idx = clen - 1 - t            # forward position t
-            node = e11(path_v[pl.ds(idx, 1), 0:1]) // pkr - 2
+            node = path_s[clen - 1 - t] // pkr - 2   # forward pos t
             cov = nseq_s[node]
             hit = (first < 0) & (cov >= avg)
             return jnp.where(hit, t, first)
 
         def scan_bwd(t, last):
-            node = e11(path_v[pl.ds(t, 1), 0:1]) // pkr - 2
+            node = path_s[t] // pkr - 2
             cov = nseq_s[node]
             hit = (last < 0) & (cov >= avg)
             return jnp.where(hit, clen - 1 - t, last)
@@ -757,8 +820,7 @@ def _kernel(nlay_ref, bblen_ref,
         length = jnp.maximum(cend - cbegin + 1, 0)
 
         def emit(t, _):
-            node = e11(path_v[pl.ds(clen - 1 - (cbegin + t), 1),
-                              0:1]) // pkr - 2
+            node = path_s[clen - 1 - (cbegin + t)] // pkr - 2
             cons_ref[0, pl.ds(t, 1), 0:1] = jnp.full(
                 (1, 1), base_s[node], jnp.int32)
             return 0
@@ -779,8 +841,6 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
     int32 (begin, end, full_span, slen, ...), nlay/bblen: [B] int32.
     Returns (cons [B, V, 1] int32, mout [B, 8, 1] int32)."""
     b = seqs.shape[0]
-    q = 128
-    n_sl = (max(0, lp + 1 - wb) + q - 1) // q + 1
     pkr = 1
     while pkr < lp + 8:
         pkr <<= 1
@@ -790,7 +850,7 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
 
     kern = functools.partial(
         _kernel, v=v, lp=lp, d1=d1, p=p, s_=s_, a_=a_, k=k, wb=wb,
-        n_sl=n_sl, match=match, mismatch=mismatch, gap=gap,
+        match=match, mismatch=mismatch, gap=gap,
         wtype=wtype, trim=trim)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -811,16 +871,13 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
         ),
         scratch_shapes=[
             pltpu.VMEM((v, p), jnp.int32),       # preds
-            pltpu.VMEM((v, p), jnp.int32),       # predw
             pltpu.VMEM((v, s_), jnp.int32),      # succs
-            pltpu.VMEM((v, s_), jnp.int32),      # succw
             pltpu.VMEM((v, s_), jnp.int32),      # succanch
-            pltpu.VMEM((v, a_), jnp.int32),      # aligned
             pltpu.VMEM((v, wb), jnp.float32),    # ring (node-indexed)
             pltpu.VMEM((v, wb), jnp.int32),      # dirs (node-indexed)
             pltpu.VMEM((8, wb), jnp.float32),    # accs
             pltpu.VMEM((8, wb), jnp.int32),      # arga
-            pltpu.VMEM((v + lp, 1), jnp.int32),  # packed path
+            pltpu.VMEM((8, lp + 256), jnp.int32),  # staged chr*256+wt
             pltpu.SMEM((v,), jnp.int32),         # base
             pltpu.SMEM((v,), jnp.int32),         # anchor
             pltpu.SMEM((v,), jnp.int32),         # nseqs
@@ -830,11 +887,18 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
             pltpu.SMEM((v,), jnp.int32),         # pred count
             pltpu.SMEM((v,), jnp.int32),         # succ count
             pltpu.SMEM((4 * v,), jnp.int32),     # pred id mirror
+            pltpu.SMEM((4 * v,), jnp.int32),     # succ id mirror
             pltpu.SMEM((v,), jnp.int32),         # order
             pltpu.SMEM((v,), jnp.int32),         # sink-by-rank
             pltpu.SMEM((v,), jnp.int32),         # consensus score
             pltpu.SMEM((v,), jnp.int32),         # consensus pred
-            pltpu.SMEM((8,), jnp.int32),         # regs
+            pltpu.SMEM((v * p,), jnp.int32),     # pred weights
+            pltpu.SMEM((v * s_,), jnp.int32),    # succ weights
+            pltpu.SMEM((v * s_,), jnp.int32),    # succ->pred slot
+            pltpu.SMEM((v + lp,), jnp.int32),    # packed path
+            pltpu.SMEM((v * a_,), jnp.int32),    # aligned-group ids
+            pltpu.SMEM((v,), jnp.int32),         # aligned-group count
+            pltpu.SMEM((12,), jnp.int32),        # regs
         ],
     )
     return pl.pallas_call(

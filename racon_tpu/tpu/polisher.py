@@ -128,6 +128,14 @@ class TPUPolisher(Polisher):
         lcap = self._bucket_dim(2 * w)
         return vcap, lcap
 
+
+    def _tail_workers(self, device_only_env: str) -> int:
+        """CPU workers for a hybrid stage: all but one thread, zero
+        when the env forces device-only execution."""
+        if os.environ.get(device_only_env):
+            return 0
+        return max(0, self.num_threads - 1)
+
     def generate_consensuses(self) -> List[bool]:
         if self.tpu_poa_batches <= 0:
             return super().generate_consensuses()
@@ -187,9 +195,7 @@ class TPUPolisher(Polisher):
         from collections import deque
 
         lock = threading.Lock()
-        n_workers = max(0, self.num_threads - 1)
-        if os.environ.get("RACON_TPU_POA_DEVICE_ONLY"):
-            n_workers = 0
+        n_workers = self._tail_workers("RACON_TPU_POA_DEVICE_ONLY")
         steal = bool(os.environ.get("RACON_TPU_STEAL")) and n_workers
         work = deque(eligible)
         if steal or not n_workers:
@@ -198,7 +204,7 @@ class TPUPolisher(Polisher):
             dev_left = _split_cut(
                 [len(self.windows[i].sequences) ** 2
                  for i in eligible],
-                float(os.environ.get("RACON_TPU_POA_SPLIT", "0.45")))
+                float(os.environ.get("RACON_TPU_POA_SPLIT", "0.62")))
 
         def cpu_worker():
             while True:
@@ -330,9 +336,7 @@ class TPUPolisher(Polisher):
 
         from racon_tpu.ops import cpu as cpu_ops
 
-        n_workers = max(0, self.num_threads - 1)
-        if os.environ.get("RACON_TPU_ALIGN_DEVICE_ONLY"):
-            n_workers = 0
+        n_workers = self._tail_workers("RACON_TPU_ALIGN_DEVICE_ONLY")
         dims = [d for d, _ in pending]
         n_dev = len(self.mesh.devices)
         if not n_workers:
@@ -400,9 +404,7 @@ class TPUPolisher(Polisher):
         # full wavefront dispatch + its own compiled variant
         pending = [(self._bucket_dim(d), o) for d, o in pending]
 
-        n_workers = max(0, self.num_threads - 1)
-        if os.environ.get("RACON_TPU_ALIGN_DEVICE_ONLY"):
-            n_workers = 0
+        n_workers = self._tail_workers("RACON_TPU_ALIGN_DEVICE_ONLY")
         steal = bool(os.environ.get("RACON_TPU_STEAL")) and n_workers
         work = deque(pending)
         if steal or not n_workers:

@@ -1,27 +1,35 @@
 """Test configuration.
 
-Forces JAX onto the CPU backend with 8 virtual devices so multi-chip
-sharding paths can be exercised without TPU hardware, mirroring the
-driver's dryrun environment.  Must run before jax is imported anywhere.
+By default, forces JAX onto the CPU backend with 8 virtual devices so
+multi-chip sharding paths can be exercised without TPU hardware,
+mirroring the driver's dryrun environment.  Must run before jax is
+imported anywhere.
+
+Set ``RACON_TPU_TEST_PLATFORM=tpu`` to keep the real backend so the
+on-hardware tests run (the analog of the reference CI's
+``--gtest_filter=*CUDA*`` pass, ci/gpu/build.sh:36-38); ci/tpu/test.sh
+does this.
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("RACON_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
-# The environment's sitecustomize may have imported jax (and registered
-# a TPU backend) before this file runs, so env vars alone are too late;
-# jax.config still applies because no backend is initialized yet.
-try:
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-except ImportError:
-    pass
+    # The environment's sitecustomize may have imported jax (and
+    # registered a TPU backend) before this file runs, so env vars
+    # alone are too late; jax.config still applies because no backend
+    # is initialized yet.
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:
+        pass
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:

@@ -6,8 +6,9 @@ between the polished contig (reverse-complemented -- the sample layout
 is the reverse complement of the sample reference) and the known
 reference sequence.  The reference's CPU goldens are recorded in
 comments; our engine is spoa/edlib-equivalent but not bit-identical, so
-our own measured values are pinned with a small guard band, the same
-latitude the reference gives its CUDA path (racon_test.cpp:312).
+our own byte-deterministic values are pinned EXACTLY (reference
+numbers in comments, the racon_test.cpp:312 convention), so a
+single-point accuracy drift fails the suite.
 """
 
 import os
@@ -64,7 +65,7 @@ def test_consensus_with_qualities(reference_data):
                             "sample_layout.fasta.gz")
     assert len(polished) == 1
     d = polished_distance(reference_data, polished[0].data)
-    assert d < 1450, f"consensus accuracy regressed: {d}"
+    assert d == 1321, f"consensus accuracy drifted: {d} != 1321"
 
 
 @pytest.mark.slow
@@ -75,7 +76,7 @@ def test_consensus_without_qualities(reference_data):
                             "sample_layout.fasta.gz")
     assert len(polished) == 1
     d = polished_distance(reference_data, polished[0].data)
-    assert d < 1750, f"consensus accuracy regressed: {d}"
+    assert d == 1470, f"consensus accuracy drifted: {d} != 1470"
 
 
 @pytest.mark.slow
@@ -86,7 +87,7 @@ def test_consensus_with_qualities_and_alignments(reference_data):
                             "sample_layout.fasta.gz")
     assert len(polished) == 1
     d = polished_distance(reference_data, polished[0].data)
-    assert d < 1450, f"consensus accuracy regressed: {d}"
+    assert d == 1340, f"consensus accuracy drifted: {d} != 1340"
 
 
 @pytest.mark.slow
@@ -97,7 +98,7 @@ def test_consensus_without_qualities_and_with_alignments(reference_data):
                             "sample_layout.fasta.gz")
     assert len(polished) == 1
     d = polished_distance(reference_data, polished[0].data)
-    assert d < 1950, f"consensus accuracy regressed: {d}"
+    assert d == 1836, f"consensus accuracy drifted: {d} != 1836"
 
 
 @pytest.mark.slow
@@ -108,7 +109,7 @@ def test_consensus_with_qualities_larger_window(reference_data):
                             "sample_layout.fasta.gz", window=1000)
     assert len(polished) == 1
     d = polished_distance(reference_data, polished[0].data)
-    assert d < 1450, f"consensus accuracy regressed: {d}"
+    assert d == 1316, f"consensus accuracy drifted: {d} != 1316"
 
 
 @pytest.mark.slow
@@ -120,7 +121,7 @@ def test_consensus_with_qualities_edit_distance_scores(reference_data):
                             match=1, mismatch=-1, gap=-1)
     assert len(polished) == 1
     d = polished_distance(reference_data, polished[0].data)
-    assert d < 1500, f"consensus accuracy regressed: {d}"
+    assert d == 1331, f"consensus accuracy drifted: {d} != 1331"
 
 
 @pytest.mark.slow
@@ -135,7 +136,8 @@ def test_fragment_correction_with_qualities(reference_data):
                             match=1, mismatch=-1, gap=-1, drop=True)
     assert len(polished) == 39
     total = sum(len(s.data) for s in polished)
-    assert abs(total - 389394) < 4000, f"total length drifted: {total}"
+    # ours: 389,344 (exact, deterministic)
+    assert total == 389344, f"total length drifted: {total}"
 
 
 @pytest.mark.slow
@@ -148,7 +150,8 @@ def test_fragment_correction_with_qualities_full(reference_data):
                             match=1, mismatch=-1, gap=-1, drop=False)
     assert len(polished) == 236
     total = sum(len(s.data) for s in polished)
-    assert abs(total - 1658216) < 17000, f"total length drifted: {total}"
+    # ours: 1,658,006 (exact, deterministic)
+    assert total == 1658006, f"total length drifted: {total}"
 
 
 @pytest.mark.slow
@@ -161,7 +164,8 @@ def test_fragment_correction_without_qualities_full(reference_data):
                             match=1, mismatch=-1, gap=-1, drop=False)
     assert len(polished) == 236
     total = sum(len(s.data) for s in polished)
-    assert abs(total - 1663982) < 17000, f"total length drifted: {total}"
+    # ours: 1,663,617 (exact, deterministic)
+    assert total == 1663617, f"total length drifted: {total}"
 
 
 @pytest.mark.slow
@@ -175,7 +179,9 @@ def test_fragment_correction_with_qualities_full_mhap(reference_data):
                             match=1, mismatch=-1, gap=-1, drop=False)
     assert len(polished) == 236
     total = sum(len(s.data) for s in polished)
-    assert abs(total - 1658216) < 17000, f"total length drifted: {total}"
+    # ours: 1,658,006 — exactly equal to the PAF run, like the
+    # reference's MHAP parity check
+    assert total == 1658006, f"total length drifted: {total}"
 
 
 def test_invalid_polisher_inputs(reference_data):

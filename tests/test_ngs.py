@@ -1,0 +1,114 @@
+"""NGS (short-read) window path.
+
+Covers what the reference leaves implicit: mean read length <= 1000
+selects the kNGS window type (reference: src/polisher.cpp:275-276),
+whose consensus skips the TGS coverage trim (src/window.cpp:118-139
+gates the trim on kTGS), and the Illumina pair preprocessor
+(scripts/racon_preprocess.py port) feeds renamed reads straight into
+the pipeline.
+"""
+
+import os
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.core.window import WindowType
+from racon_tpu.ops import cpu
+from racon_tpu.tools import preprocess, simulate
+
+
+def _read_fasta(path):
+    seqs = []
+    with open(path, "rb") as fh:
+        for line in fh:
+            if not line.startswith(b">"):
+                seqs.append(line.strip())
+    return b"".join(seqs)
+
+
+def _polish(reads, paf, draft, **kw):
+    pol = create_polisher(reads, paf, draft, PolisherType.kC, 500,
+                          -1.0, 0.3, True, 5, -4, -8, num_threads=4,
+                          **kw)
+    pol.initialize()
+    # polish() consumes the window list, so capture the types now
+    wtypes = {w.type for w in pol.windows}
+    return wtypes, pol.polish(True)
+
+
+def test_ngs_window_type_and_polish(tmp_path):
+    reads, paf, draft = simulate.simulate(
+        str(tmp_path), genome_len=8_000, coverage=12, read_len=400,
+        seed=3)
+    truth = _read_fasta(os.path.join(str(tmp_path), "genome.fasta"))
+    d_draft = cpu.edit_distance(_read_fasta(draft), truth)
+
+    wtypes, out = _polish(reads, paf, draft)
+    # mean read length <= 1000 -> every window is kNGS
+    assert wtypes == {WindowType.NGS}
+    d = cpu.edit_distance(out[0].data, truth)
+    assert d < d_draft / 2, (d, d_draft)
+
+    # accelerated-polisher path (lockstep engine on the CPU test
+    # backend) must take the same no-trim NGS consensus branch
+    wtypes2, out2 = _polish(reads, paf, draft, tpu_poa_batches=1,
+                            tpu_aligner_batches=1)
+    assert wtypes2 == {WindowType.NGS}
+    d2 = cpu.edit_distance(out2[0].data, truth)
+    assert d2 < d_draft / 2, (d2, d_draft)
+
+
+def test_preprocess_feeds_pipeline(tmp_path):
+    # paired-end FASTQ with colliding headers, like the reference's
+    # preprocessor expects (scripts/racon_preprocess.py)
+    reads, paf, draft = simulate.simulate(
+        str(tmp_path), genome_len=6_000, coverage=10, read_len=300,
+        seed=9)
+    records = []
+    with open(reads) as fh:
+        lines = fh.read().splitlines()
+    for i in range(0, len(lines), 4):
+        records.append((lines[i], lines[i + 1], lines[i + 3]))
+
+    half = (len(records) + 1) // 2
+    r1 = tmp_path / "r1.fastq"
+    r2 = tmp_path / "r2.fastq"
+    with open(r1, "w") as f1:
+        for name, data, qual in records[:half]:
+            f1.write(f"{name}\n{data}\n+\n{qual}\n")
+    with open(r2, "w") as f2:
+        # same headers as r1: the pair collision the tool resolves
+        for (name, _, _), (o_name, data, qual) in zip(
+                records[:half], records[half:]):
+            f2.write(f"{name}\n{data}\n+\n{qual}\n")
+
+    prep = tmp_path / "prep.fastq"
+    read_set = set()
+    with open(prep, "w") as out:
+        preprocess.parse_file(str(r1), read_set, out)
+        preprocess.parse_file(str(r2), read_set, out)
+
+    # every rewritten header is unique: suffix 1 for first occurrence,
+    # 2 for its pair
+    names = [ln for ln in open(prep).read().splitlines()
+             if ln.startswith("@")]
+    assert len(names) == len(set(names)) == 2 * half - \
+        (half - len(records[half:]))
+    assert all(n.endswith(("1", "2")) for n in names)
+
+    # the preprocessed file parses and drives a polish end to end
+    # (overlaps reference the ORIGINAL names, so rebuild a PAF against
+    # the renamed reads by suffixing query names the same way)
+    import gzip  # noqa: F401  (parity with other e2e tests' imports)
+    seen = set()
+    paf2 = tmp_path / "prep.paf"
+    with open(paf) as fi, open(paf2, "w") as fo:
+        for line in fi:
+            cols = line.split("\t")
+            if cols[0] in seen:
+                cols[0] += "2"
+            else:
+                seen.add(cols[0])
+                cols[0] += "1"
+            fo.write("\t".join(cols))
+    wtypes, out = _polish(str(prep), str(paf2), draft)
+    assert out and wtypes == {WindowType.NGS}
