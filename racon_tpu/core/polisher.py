@@ -172,6 +172,9 @@ class Polisher:
         window_type = (WindowType.NGS
                        if total_sequences_length / sequences_size <= 1000
                        else WindowType.TGS)
+        # recorded for subclasses that predict device-kernel variants
+        # before windows exist (racon_tpu/tpu/polisher.py prewarm)
+        self.window_type = window_type
 
         self.logger.log("[racon_tpu::Polisher::initialize] loaded sequences")
         self.logger.log()

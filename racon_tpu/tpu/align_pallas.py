@@ -84,13 +84,14 @@ def available() -> bool:
 
 
 def _kernel(ql_ref, tl_ref, q_ref, t_ref, tape_ref, dist_ref,
-            ckpt_hbm, ckstage, dirs, dsem, regs_s, *,
+            ckpt_hbm, ckstage, dirs, taperow, dsem, regs_s, *,
             lq: int, lt: int, wb: int, ckrows: int):
     g0 = pl.program_id(0) * _S
     nck8 = (lq // ckrows + 1) * 8
     ck0 = pl.program_id(0) * nck8      # this program's HBM region
     q = 128
     tape_w = (lq + lt) // 16 + 1
+    tape_rows = (tape_w + 127) // 128
     big = jnp.int32(_BIG)
     cols = lax.broadcasted_iota(jnp.int32, (1, wb), 1)
     cols_s = lax.broadcasted_iota(jnp.int32, (_S, wb), 1)
@@ -242,7 +243,7 @@ def _kernel(ql_ref, tl_ref, q_ref, t_ref, tape_ref, dist_ref,
 
     # ---- pass 2: checkpointed traceback, all pairs per block --------
     for s in range(_S):
-        tape_ref[s, :, :] = jnp.zeros((tape_w, 1), jnp.int32)
+        tape_ref[s, :, :] = jnp.zeros((tape_rows, 128), jnp.int32)
     # regs per pair s at base s*8: 0 word, 1 word count, 2 bit count,
     # 3 i, 4 j
     for s in range(_S):
@@ -252,6 +253,22 @@ def _kernel(ql_ref, tl_ref, q_ref, t_ref, tape_ref, dist_ref,
         regs_s[s * 8 + 3] = qls[s]
         regs_s[s * 8 + 4] = tls[s]
 
+    def put_word(s, w):
+        """Append one finished 16-move word: accumulate into the
+        pair's 128-lane row register and flush whole rows -- the tape
+        output packs 128 words per sublane row, so nothing is stored
+        through the ~800ns dynamic-scalar path and the block is not
+        lane-padded 128x in VMEM."""
+        wcnt = regs_s[s * 8 + 1]
+        lane = wcnt % 128
+        taperow[s:s + 1, :] = jnp.where(iota_c == lane, w,
+                                        taperow[s:s + 1, :])
+
+        @pl.when(lane == 127)
+        def _():
+            tape_ref[s, pl.ds(wcnt // 128, 1), :] = taperow[s:s + 1, :]
+        regs_s[s * 8 + 1] = wcnt + 1
+
     def emit(s, mv):
         w = regs_s[s * 8] | (mv << (regs_s[s * 8 + 2] * 2))
         nb = regs_s[s * 8 + 2] + 1
@@ -259,10 +276,8 @@ def _kernel(ql_ref, tl_ref, q_ref, t_ref, tape_ref, dist_ref,
 
         @pl.when(full)
         def _():
-            tape_ref[s, pl.ds(regs_s[s * 8 + 1], 1), 0:1] = jnp.full(
-                (1, 1), w, jnp.int32)
+            put_word(s, w)
             regs_s[s * 8] = jnp.int32(0)
-            regs_s[s * 8 + 1] = regs_s[s * 8 + 1] + 1
             regs_s[s * 8 + 2] = jnp.int32(0)
 
         @pl.when(jnp.logical_not(full))
@@ -336,9 +351,14 @@ def _kernel(ql_ref, tl_ref, q_ref, t_ref, tape_ref, dist_ref,
     for s in range(_S):
         @pl.when(regs_s[s * 8 + 2] > 0)
         def _(s=s):
-            tape_ref[s, pl.ds(regs_s[s * 8 + 1], 1), 0:1] = jnp.full(
-                (1, 1), regs_s[s * 8], jnp.int32)
-            regs_s[s * 8 + 1] = regs_s[s * 8 + 1] + 1
+            put_word(s, regs_s[s * 8])
+
+        # flush the partial final row (garbage tail lanes are beyond
+        # the move count the host slices by)
+        @pl.when(regs_s[s * 8 + 1] % 128 > 0)
+        def _(s=s):
+            tape_ref[s, pl.ds(regs_s[s * 8 + 1] // 128, 1), :] = \
+                taperow[s:s + 1, :]
         dist_ref[s, 1:2, 0:1] = jnp.full(
             (1, 1),
             regs_s[s * 8 + 1] * 16 - jnp.where(
@@ -351,6 +371,7 @@ def _align(q, t, ql, tl, lq: int, lt: int, wb: int,
            interpret: bool = False):
     b = q.shape[0]
     tape_w = (lq + lt) // 16 + 1
+    tape_rows = (tape_w + 127) // 128
     q_i = q.astype(jnp.int32)[:, None, :]
     t_i = jnp.pad(t.astype(jnp.int32), ((0, 0), (0, wb + 128)),
                   constant_values=-1)[:, None, :]
@@ -368,7 +389,7 @@ def _align(q, t, ql, tl, lq: int, lt: int, wb: int,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((_S, tape_w, 1), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((_S, tape_rows, 128), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((_S, 8, 1), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -377,6 +398,7 @@ def _align(q, t, ql, tl, lq: int, lt: int, wb: int,
         scratch_shapes=[
             pltpu.VMEM((8, wb), jnp.int32),                    # stage
             pltpu.VMEM((ckrows * 8, wb), jnp.int32),           # dirs
+            pltpu.VMEM((8, 128), jnp.int32),                   # taperow
             pltpu.SemaphoreType.DMA(()),
             pltpu.SMEM((8 * _S,), jnp.int32),                  # regs
         ],
@@ -385,13 +407,51 @@ def _align(q, t, ql, tl, lq: int, lt: int, wb: int,
     tape, meta, _ = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
-        out_shape=(jax.ShapeDtypeStruct((b, tape_w, 1), jnp.int32),
+        out_shape=(jax.ShapeDtypeStruct((b, tape_rows, 128),
+                                        jnp.int32),
                    jax.ShapeDtypeStruct((b, 8, 1), jnp.int32),
                    jax.ShapeDtypeStruct((b // _S * nck8, wb),
                                         jnp.int32)),
         interpret=interpret,
     )(ql, tl, q_i, t_i)
     return tape, meta
+
+
+def per_pair_bytes(bd: int, wb: int) -> int:
+    """Device bytes one queued pair costs at band ``wb``: the
+    checkpoint HBM region plus q/t/tape buffers (shared by the
+    dispatch chunking and the shape-prediction prewarm)."""
+    return (bd // _ckrows(wb) + 1) * wb * 4 + 6 * bd
+
+
+def pad_pairs(n: int, n_dev: int = 1) -> int:
+    """Batch padding rule: power of two, a multiple of the stacking
+    factor and of the mesh size."""
+    from racon_tpu.utils.tuning import pow2_at_least
+
+    n_pad = pow2_at_least(max(n, _S), _S)
+    return n_pad + (-n_pad) % (_S * n_dev)
+
+
+def prewarm(n: int, lq: int, lt: int, wb: int, mesh=None) -> None:
+    """Populate the jit dispatch cache for one (batch, dims, band)
+    variant with an all-empty batch through THE SAME entry production
+    dispatch uses (sharded when the mesh has more than one device);
+    run from a background thread so later band rungs are already
+    traced+compiled when the first rung finishes."""
+    from racon_tpu.parallel.mesh_utils import interpret_mode
+
+    interp = interpret_mode()
+    q = jnp.zeros((n, lq), jnp.uint8)
+    t = jnp.zeros((n, lt), jnp.uint8)
+    zl = jnp.zeros((n,), jnp.int32)
+    n_dev = len(mesh.devices) if mesh is not None else 1
+    if n_dev > 1:
+        out = _align_sharded(q, t, zl, zl, mesh=mesh, lq=lq, lt=lt,
+                             wb=wb, interpret=interp)
+    else:
+        out = _align(q, t, zl, zl, lq, lt, wb, interp)
+    jax.block_until_ready(out)
 
 
 @functools.partial(jax.jit,
@@ -426,9 +486,7 @@ def align_batch(queries, targets, lq: int, lt: int, wb: int,
     n_dev = len(mesh.devices) if mesh is not None else 1
     # pad the pair count to a power of two so grid sizes (and thus
     # compiled variants) stay bucketed; empty pairs cost ~nothing
-    from racon_tpu.utils.tuning import pow2_at_least
-    n_pad = pow2_at_least(max(n_real, _S), _S)
-    n_pad += (-n_pad) % (_S * n_dev)
+    n_pad = pad_pairs(n_real, n_dev)
     queries = list(queries) + [b""] * (n_pad - n_real)
     targets = list(targets) + [b""] * (n_pad - n_real)
     q = encode_batch(queries, lq, _QPAD)
@@ -445,7 +503,8 @@ def align_batch(queries, targets, lq: int, lt: int, wb: int,
         tape, meta = _align(q, t, ql, tl, lq, lt, wb, interp)
     tape.copy_to_host_async()
     meta.copy_to_host_async()
-    tape = np.asarray(tape)[:n_real, :, 0].astype(np.uint32)
+    tape = np.asarray(tape)[:n_real].reshape(n_real, -1) \
+        .astype(np.uint32)
     meta = np.asarray(meta)[:n_real, :, 0]
     n = tape.shape[1] * 16
     moves = np.zeros((tape.shape[0], n), np.uint8)

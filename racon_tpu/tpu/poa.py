@@ -470,8 +470,7 @@ class TPUPoaBatchEngine:
         from racon_tpu.utils.tuning import pow2_at_least
 
         lp = self.lcap
-        wb = max(256, ((self.band_cols or lp // 4) + 127) & ~127)
-        wb = min(wb, ((lp + 127) & ~127))
+        wb = poa_pallas.band_width(lp, self.band_cols)
         depth = max((min(len(w.sequences) - 1, self.max_depth)
                      for w in windows), default=0)
         d1 = max(8, pow2_at_least(depth + 1, 8))
@@ -516,8 +515,7 @@ class TPUPoaBatchEngine:
         v, lp = self.vcap, self.lcap
         # -b narrows the band; the on-device DP needs >= 256 columns
         # (quantum 128), so the narrow setting clamps up
-        wb = max(256, ((self.band_cols or lp // 4) + 127) & ~127)
-        wb = min(wb, ((lp + 127) & ~127))
+        wb = poa_pallas.band_width(lp, self.band_cols)
         d1 = max(8, pow2_at_least(
             max((len(ll) for ll in layer_lists), default=0) + 1, 8))
         b_pad = max(8, pow2_at_least(n, 8))

@@ -82,6 +82,36 @@ def available() -> bool:
         return False
 
 
+def band_width(lp: int, band_cols: int = 0) -> int:
+    """The on-device DP band width for layer cap ``lp`` (same clamp
+    the engine and the shape-prediction prewarm must agree on)."""
+    wb = max(256, ((band_cols or lp // 4) + 127) & ~127)
+    return min(wb, ((lp + 127) & ~127))
+
+
+def prewarm(b: int, d1: int, *, v: int, lp: int, wb: int,
+            p: int = 16, s: int = 16, a: int = 8, k: int = 128,
+            match: int = 5, mismatch: int = -4, gap: int = -8,
+            wtype: int = 1, trim: int = 1, mesh=None) -> None:
+    """Populate the jit dispatch cache for one kernel shape by running
+    an inert 1-base batch (device-side zeros, no host upload) through
+    THE SAME entry production dispatch uses (sharded when the mesh has
+    more than one device).  Called from a background thread while the
+    align stage owns the device: kernel tracing (~1 s) plus the
+    persistent-cache compile load (~1.5 s) dominate cold starts when
+    paid serially."""
+    seqs = np.zeros((b, d1, lp), np.uint8)
+    seqs[:, 0, 0] = ord("A")
+    wts = np.ones((b, d1, lp), np.uint8)
+    meta = np.zeros((b, d1, 8), np.int32)
+    nlay = np.zeros((b,), np.int32)
+    bblen = np.ones((b,), np.int32)
+    poa_full_batch(seqs, wts, meta, nlay, bblen, v=v, lp=lp, d1=d1,
+                   p=p, s=s, a=a, k=k, wb=wb, match=match,
+                   mismatch=mismatch, gap=gap, wtype=wtype, trim=trim,
+                   mesh=mesh)
+
+
 def fits(v: int, lp: int, d1: int, p: int, s: int, a: int,
          wb: int) -> bool:
     """Conservative per-program VMEM estimate: ring + dirs (v x wb),

@@ -273,10 +273,79 @@ class TPUPolisher(Polisher):
     # aligner stage (reference: src/cuda/cudapolisher.cpp:72-217)
     # ------------------------------------------------------------------
 
+    def _prewarm_poa_async(self, overlaps: List[Overlap]) -> None:
+        """Trace+compile the PREDICTED POA kernel variants on a daemon
+        thread while the align stage owns the device.  Tracing plus
+        the persistent-cache compile load cost ~2.5 s per variant and
+        otherwise serialize after the align stage; the window depth
+        (-> d1 bucket) and first-megabatch size are estimated from the
+        filtered overlaps, and a mispredicted shape only wastes
+        background work."""
+        if self.tpu_poa_batches <= 0:
+            return
+        import jax
+
+        from racon_tpu.tpu import poa_pallas
+        if not poa_pallas.available() or \
+                jax.devices()[0].platform != "tpu":
+            return
+        import threading
+
+        from racon_tpu.utils.tuning import pow2_at_least
+
+        # exact window-depth upper bound from the filtered overlaps: a
+        # coverage diff-array over window indices per target (the
+        # first megabatch takes the DEEPEST windows, so d1 follows the
+        # max depth, clipped by the engine's per-window layer cap)
+        tlen = {}
+        for o in overlaps:
+            tlen[o.t_id] = max(tlen.get(o.t_id, 0), o.t_end)
+        w = self.window_length
+        diff = {t: np.zeros(length // w + 2, np.int32)
+                for t, length in tlen.items()}
+        for o in overlaps:
+            d = diff[o.t_id]
+            d[o.t_begin // w] += 1
+            d[o.t_end // w + 1] -= 1
+        max_depth = max((int(np.cumsum(d).max()) for d in diff.values()),
+                        default=0)
+        max_depth = min(max_depth, self.MAX_DEPTH_PER_WINDOW)
+        d1_top = max(8, pow2_at_least(max_depth + 1, 8))
+        d1s = sorted({d1_top, max(8, d1_top // 2)})
+        vcap, lcap = self._poa_caps()
+        wb = poa_pallas.band_width(
+            lcap, 128 if self.tpu_banded_alignment else 0)
+        n_dev = len(self.mesh.devices)
+        n_win = sum(length // self.window_length + 1
+                    for length in tlen.values())
+        take = min(self._poa_batch_size(vcap, lcap, n_dev),
+                   n_dev * _env_int("RACON_TPU_POA_MEGABATCH", 256),
+                   max(8, int(0.55 * n_win)))
+        b_pad = max(8, pow2_at_least(take, 8))
+        b_pad += (-b_pad) % n_dev
+
+        wtype = self.window_type.value
+        mesh = self.mesh
+
+        def work():
+            for d1 in d1s:
+                try:
+                    if poa_pallas.fits(vcap, lcap, d1, 16, 16, 8, wb):
+                        poa_pallas.prewarm(
+                            b_pad, d1, v=vcap, lp=lcap, wb=wb,
+                            match=self.match, mismatch=self.mismatch,
+                            gap=self.gap, wtype=wtype, mesh=mesh)
+                except Exception:
+                    return  # prewarm is best-effort only
+
+        threading.Thread(target=work, daemon=True,
+                         name="racon-poa-prewarm").start()
+
     def find_overlap_breaking_points(self, overlaps: List[Overlap]) -> None:
         if self.tpu_aligner_batches > 0:
             import time
             from jax.profiler import TraceAnnotation
+            self._prewarm_poa_async(overlaps)
             t0 = time.monotonic()
             with TraceAnnotation("racon_tpu.device_align"):
                 self._device_align_overlaps(overlaps)
@@ -498,6 +567,7 @@ class TPUPolisher(Polisher):
                 for i, (q, t) in enumerate(zip(queries, targets))]
         pending = list(range(len(overlaps)))
         rungs = (2048, 4096, 8192)
+        self._prewarm_align_rungs(rungs, need, dabs, bd)
         for wb in rungs:
             if not pending:
                 break
@@ -508,13 +578,11 @@ class TPUPolisher(Polisher):
                    or (wb == rungs[-1] and 2 * dabs[i] <= wb - 512)]
             if not idx:
                 continue
-            # the kernel's checkpoint HBM out-buffer costs
-            # (bd/ckrows + 1) * wb * 4 bytes per pair (plus q/t/tape);
-            # chunk the dispatch so one batch stays in budget
-            per_pair = ((bd // align_pallas._ckrows(wb) + 1) * wb * 4
-                        + 6 * bd)
+            # chunk the dispatch so one batch's device footprint
+            # (checkpoint HBM region + q/t/tape) stays in budget
             max_b = max(8 * len(self.mesh.devices),
-                        int(self.align_mem_budget // per_pair))
+                        int(self.align_mem_budget
+                            // align_pallas.per_pair_bytes(bd, wb)))
             max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
             n_cert = 0
             still = set()
@@ -544,6 +612,59 @@ class TPUPolisher(Polisher):
                 f"{n_cert}/{len(idx)} overlaps (band {wb})")
         # survivors lack a CIGAR and take the CPU fall-through
         # (the reference's exceeded_max_alignment_difference skip)
+
+    def _prewarm_align_rungs(self, rungs, need, dabs, bd) -> None:
+        """Trace+compile the LATER band rungs' kernel variants on a
+        daemon thread while the first rung owns the device (the rung
+        sets are re-derived exactly as the dispatch loop will, minus
+        retries — a retry-shifted batch shape just costs one more
+        foreground trace, same as before)."""
+        import jax
+
+        from racon_tpu.tpu import align_pallas
+        try:
+            if jax.devices()[0].platform != "tpu":
+                return
+        except Exception:
+            return
+        import threading
+
+        n_dev = len(self.mesh.devices)
+        shapes = []
+        pend = list(range(len(need)))
+        first = True
+        for wb in rungs:
+            idx = [i for i in pend
+                   if need[i] + dabs[i] <= wb - 512
+                   or (wb == rungs[-1] and 2 * dabs[i] <= wb - 512)]
+            if not idx:
+                continue
+            if not first:
+                max_b = max(8 * n_dev,
+                            int(self.align_mem_budget
+                                // align_pallas.per_pair_bytes(bd,
+                                                               wb)))
+                max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
+                n_pad = align_pallas.pad_pairs(min(len(idx), max_b),
+                                               n_dev)
+                shapes.append((n_pad, wb))
+            first = False
+            hit = set(idx)
+            pend = [i for i in pend if i not in hit]
+
+        if not shapes:
+            return
+        mesh = self.mesh
+
+        def work():
+            for n_pad, wb in shapes:
+                try:
+                    align_pallas.prewarm(n_pad, bd, bd, wb, mesh=mesh)
+                except Exception:
+                    return
+
+        threading.Thread(target=work, daemon=True,
+                         name="racon-align-prewarm").start()
 
     def _align_chunk(self, chunk: List[Overlap], blq: int, blt: int,
                      n_dev: int) -> None:
