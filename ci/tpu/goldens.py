@@ -1,0 +1,97 @@
+"""TPU-path golden outputs: generate or check.
+
+The accelerated path is byte-deterministic, so its polished FASTA is
+committed verbatim and diffed in CI — the analog of the reference's
+2.6 MB golden-output diff (reference: ci/gpu/cuda_test.sh:33 +
+ci/gpu/golden-output.txt).  A code change that shifts one output byte
+fails `--check`; an INTENDED behavior change regenerates with
+`--regen` (and the diff shows up in review).
+
+Goldens:
+  tests/golden/sample_tpu.fasta     sample contig polish (-c 1
+                                    --tpualigner-batches 1, m5/x-4/g-8)
+  tests/golden/scale300k_tpu.fasta  300 kb / 15x seeded synthetic
+"""
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+DATA = "/root/reference/test/data"
+GOLDEN_DIR = os.path.join(REPO, "tests", "golden")
+
+
+def polish(reads, paf, draft):
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+
+    pol = create_polisher(reads, paf, draft, PolisherType.kC, 500,
+                          10.0, 0.3, True, 5, -4, -8, num_threads=8,
+                          tpu_poa_batches=1, tpu_aligner_batches=1)
+    pol.initialize()
+    out = pol.polish(True)
+    lines = []
+    for s in out:
+        lines.append(b">" + s.name.encode() + b"\n" + s.data + b"\n")
+    return b"".join(lines)
+
+
+def outputs():
+    # the sample golden is cheap to cover in test.sh with a plain cmp
+    # of the CLI output already produced there; regen still rebuilds
+    # both so the pair stays in sync
+    if sys.argv[1:2] == ["--regen"]:
+        yield "sample_tpu.fasta", polish(
+            os.path.join(DATA, "sample_reads.fastq.gz"),
+            os.path.join(DATA, "sample_overlaps.paf.gz"),
+            os.path.join(DATA, "sample_layout.fasta.gz"))
+    from racon_tpu.tools import simulate
+    with tempfile.TemporaryDirectory(prefix="racon_golden_") as tmp:
+        reads, paf, draft = simulate.simulate(
+            tmp, genome_len=300_000, coverage=15, read_len=8000,
+            seed=7)
+        yield "scale300k_tpu.fasta", polish(reads, paf, draft)
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "--check"
+    if mode not in ("--check", "--regen"):
+        print(f"usage: goldens.py [--check|--regen] (got {mode!r})")
+        return 2
+    import jax
+    if jax.devices()[0].platform != "tpu":
+        # CPU-backend bytes are not the TPU path's bytes; refusing
+        # beats silently committing (or checking against) wrong goldens
+        print("[goldens] ERROR: requires the TPU backend, found "
+              f"{jax.devices()[0].platform}")
+        return 2
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    rc = 0
+    for name, data in outputs():
+        path = os.path.join(GOLDEN_DIR, name)
+        if mode == "--regen":
+            with open(path, "wb") as fh:
+                fh.write(data)
+            print(f"[goldens] wrote {name} ({len(data)} bytes)")
+        else:
+            want = open(path, "rb").read() if os.path.exists(path) \
+                else b""
+            if data != want:
+                got = os.path.join(tempfile.gettempdir(),
+                                   name + ".got")
+                with open(got, "wb") as fh:
+                    fh.write(data)
+                print(f"[goldens] MISMATCH: {name} "
+                      f"(got {len(data)} bytes -> {got}, "
+                      f"want {len(want)})")
+                rc = 1
+            else:
+                print(f"[goldens] ok: {name}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,9 +1,14 @@
 #!/usr/bin/env bash
-# TPU test pass (reference analog: ci/gpu/cuda_test.sh): polish the
-# sample dataset twice on the accelerated path and require (a) accuracy
-# within the latitude the reference grants its CUDA path and (b)
-# byte-identical stdout across runs -- the analog of the reference's
-# 2.6 MB golden FASTA diff (ci/gpu/cuda_test.sh:33).
+# TPU test pass (reference analog: ci/gpu/cuda_test.sh + the
+# --gtest_filter=*CUDA* pass in ci/gpu/build.sh:36-38):
+#   1. polish the sample twice on the accelerated path, require
+#      byte-identical stdout (determinism) and accuracy within the
+#      latitude the reference grants its CUDA path;
+#   2. diff the accelerated outputs (sample + 300 kb scale) against
+#      the committed goldens -- a code change that shifts one output
+#      byte fails here (analog of ci/gpu/golden-output.txt);
+#   3. run the pytest suite on REAL hardware, including the on-TPU
+#      kernel/e2e tests that the CPU-forced default skips.
 set -euo pipefail
 cd "$(dirname "$0")/../.."
 ci/common/build.sh
@@ -35,4 +40,14 @@ d = cpu.edit_distance(pol.translate(comp)[::-1], ref)
 print("tpu-path edit distance:", d)
 assert d <= 1450, d   # the latitude the reference's CUDA path gets
 PY
+
+# byte-exact golden diff: the sample via the CLI output already on
+# disk, the 300 kb scale via goldens.py
+cmp /tmp/racon_tpu_ci_1.fasta tests/golden/sample_tpu.fasta
+python ci/tpu/goldens.py --check
+
+# pytest on real hardware: the kernel suites incl. the on-TPU-only
+# tests (the full platform-independent suite runs in ci/cpu)
+RACON_TPU_TEST_PLATFORM=tpu python -m pytest -q -x \
+    tests/test_align_pallas.py tests/test_poa_full_device.py
 echo "TPU CI PASS"
