@@ -1,9 +1,12 @@
 """TPU-path golden outputs: generate or check.
 
-The accelerated path is byte-deterministic, so its polished FASTA is
-committed verbatim and diffed in CI — the analog of the reference's
-2.6 MB golden-output diff (reference: ci/gpu/cuda_test.sh:33 +
-ci/gpu/golden-output.txt).  A code change that shifts one output byte
+The accelerated path is byte-deterministic FOR A FIXED CONFIG, so its
+polished FASTA is committed verbatim and diffed in CI — the analog of
+the reference's 2.6 MB golden-output diff (reference:
+ci/gpu/cuda_test.sh:33 + ci/gpu/golden-output.txt).  The hybrid
+splits are a function of thread count and device count, so these
+goldens pin the CI config: 8 threads, one TPU chip (the reference's
+golden likewise pins its CI's -t 24 GPU run).  A code change that shifts one output byte
 fails `--check`; an INTENDED behavior change regenerates with
 `--regen` (and the diff shows up in review).
 

@@ -563,7 +563,15 @@ class TPUPoaBatchEngine:
             match=self.match, mismatch=self.mismatch, gap=self.gap,
             wtype=windows[0].type.value, trim=1 if trim else 0,
             mesh=self.mesh)
-        self.phase_walls["dispatch"] += time.monotonic() - t0
+        dt = time.monotonic() - t0
+        self.phase_walls["dispatch"] += dt
+        if os.environ.get("RACON_TPU_POA_TRACE"):
+            import sys
+            live = nlay[:n][nlay[:n] > 0]
+            lo = int(live.min()) if live.size else 0
+            print(f"[poa-trace] b={n}(pad {b_pad}) d1={d1} "
+                  f"depths {lo}..{int(nlay[:n].max())} "
+                  f"wall {dt:.2f}s", file=sys.stderr, flush=True)
         self.n_rounds += 1
         self.cells += int(mout[:n, 4].sum()) * wb
 

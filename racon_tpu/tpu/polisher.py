@@ -32,6 +32,24 @@ def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, default))
 
 
+def _rate_split(dev_costs, cpu_costs) -> int:
+    """Deterministic rate-model boundary: the k minimizing
+    max(device time for the first k items, CPU time for the rest) —
+    a pure function of the input, so repeated runs are
+    byte-reproducible."""
+    dev_pre = 0.0
+    suf = sum(cpu_costs)
+    best, cut = None, len(dev_costs)
+    for k in range(len(dev_costs) + 1):
+        if k:
+            dev_pre += dev_costs[k - 1]
+            suf -= cpu_costs[k - 1]
+        t = max(dev_pre, suf)
+        if best is None or t < best:
+            best, cut = t, k
+    return cut
+
+
 def _split_cut(weights, share: float) -> int:
     """Deterministic hybrid boundary: first index where the weight
     prefix reaches ``share`` of the total (device owns [0, cut))."""
@@ -182,11 +200,15 @@ class TPUPolisher(Polisher):
         # the heterogeneous analog of the reference's per-GPU shared
         # batch queue (src/cuda/cudapolisher.cpp:257-336).  Two
         # scheduling modes:
-        #   * default: a DETERMINISTIC static split at a cost-model
-        #     boundary (depth^2 ~ graph size x layers), so repeated
-        #     runs emit byte-identical output (the two engines resolve
-        #     cost-ties differently, so assignment must not depend on
-        #     timing);
+        #   * default: a DETERMINISTIC rate-model argmin over
+        #     per-window costs depth*(1+depth/48)*(len/500) at the
+        #     measured device/CPU-worker rates, so repeated runs emit
+        #     byte-identical output (the two engines resolve cost-ties
+        #     differently, so assignment must not depend on timing --
+        #     and FOR THE SAME REASON output bytes are a function of
+        #     the thread count and device count: the committed goldens
+        #     hold for the CI config, -t 8 on one chip, exactly like
+        #     the reference's CUDA golden pins its CI config);
         #   * RACON_TPU_STEAL=1: self-balancing work stealing (device
         #     pops deep windows, CPU workers steal shallow ones) --
         #     faster when the engines' relative rates are unknown, at
@@ -200,11 +222,32 @@ class TPUPolisher(Polisher):
         work = deque(eligible)
         if steal or not n_workers:
             dev_left = len(eligible)     # device may reach everything
-        else:
+        elif "RACON_TPU_POA_SPLIT" in os.environ:
+            # manual device-share override (fraction of depth^2 weight)
             dev_left = _split_cut(
                 [len(self.windows[i].sequences) ** 2
                  for i in eligible],
-                float(os.environ.get("RACON_TPU_POA_SPLIT", "0.62")))
+                float(os.environ["RACON_TPU_POA_SPLIT"]))
+        else:
+            # deterministic rate-model argmin (like the align stage):
+            # per-window cost unit depth * (1 + depth/48) * (len/500)
+            # — superlinear in depth because inserts grow the graph —
+            # at measured r3 rates ~0.3 us/unit on one chip and
+            # ~2 us/unit per CPU worker.  A fixed share (r2's 0.62)
+            # overloaded the device ~3x on deep megabase workloads.
+            units = []
+            for i in eligible:
+                w0 = self.windows[i]
+                depth = min(len(w0.sequences) - 1,
+                            self.MAX_DEPTH_PER_WINDOW)
+                units.append(depth * (1 + depth / 48.0)
+                             * (len(w0.sequences[0]) / 500.0))
+            dev_left = _rate_split([u * 0.30 / n_dev for u in units],
+                                   [u * 2.0 / n_workers
+                                    for u in units])
+            self.logger.log(
+                f"[racon_tpu::TPUPolisher::polish] poa split: device "
+                f"{dev_left}/{len(eligible)} windows")
 
         def cpu_worker():
             while True:
@@ -415,18 +458,10 @@ class TPUPolisher(Polisher):
             cut = _split_cut(
                 dims, float(os.environ["RACON_TPU_ALIGN_SPLIT"]))
         else:
-            dev_pre = [0]
-            for d in dims:
-                dev_pre.append(
-                    dev_pre[-1] + d * self.DEV_NS_PER_ROW / n_dev)
-            best, cut = None, len(pending)
-            suf = sum(self.CPU_NS_PER_CELL * d * d for d in dims)
-            for k in range(len(pending) + 1):
-                if k:
-                    suf -= self.CPU_NS_PER_CELL * dims[k - 1] ** 2
-                t = max(dev_pre[k], suf / n_workers)
-                if best is None or t < best:
-                    best, cut = t, k
+            cut = _rate_split(
+                [d * self.DEV_NS_PER_ROW / n_dev for d in dims],
+                [self.CPU_NS_PER_CELL * d * d / n_workers
+                 for d in dims])
 
         work = deque(pending[cut:])
         lock = threading.Lock()
