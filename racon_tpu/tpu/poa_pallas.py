@@ -119,26 +119,29 @@ def fits(v: int, lp: int, d1: int, p: int, s: int, a: int,
     blocks.  Configurations over budget (e.g. -w 1000 doubles every
     cap) use the lockstep engine instead of failing to compile."""
     vmem = (v * wb * 8                        # ring f32 + dirs i32
-            + v * (p + 2 * s) * 4             # adjacency ids (VMEM)
-            + 8 * (lp + 256) * 4              # staged char/weight row
+            + v * (p + s) * 4                 # adjacency ids (VMEM)
+            + 2 * 8 * (lp + 256) * 4          # staged chw + chars rows
+            + (wb + _N_SHIFT * 128) * 4       # pred-fold staging row
             + 2 * 2 * d1 * lp * 4             # seq/wts blocks x2 buf
             + 2 * v * 128 * 4)                # cons out x2 buf
-    # SMEM: per-node scalars + mirrors + weights + the packed path;
-    # configs past the budget fail over to the lockstep engine
-    # instead of dying in the Mosaic compiler
-    smem = (v * (p + 2 * s + a + 8 + 13) + (v + lp)) * 4
+    # SMEM: per-node scalars + mirrors + weights + the packed path +
+    # the layer chw mirror; configs past the budget fail over to the
+    # lockstep engine instead of dying in the Mosaic compiler
+    smem = (v * (p + 2 * s + a + 12 + 13)
+            + (v + lp) + 8 * (lp + 256) + d1 * 8) * 4
     return vmem <= (13 << 20) and smem <= (768 << 10)
 
 
 def _kernel(nlay_ref, bblen_ref,
             seqs_ref, wts_ref, meta_ref,
             cons_ref, mout_ref,
-            preds_v, succs_v, succanch_v,
-            ring_v, dirs, accs, arga, chw_v,
+            preds_v, succs_v, stage_v,
+            ring_v, dirs, accs, arga, chw_v, chars_v,
             base_s, anch_s, nseq_s, nxt_s, glast_s,
             bandq_s, pcnt_s, scnt_s, predsm_s, succsm_s, order_s,
-            sinkr_s, score_s, cpred_s, predw_s, succw_s, pslot_s,
-            path_s, aligsm_s, gcnt_s, regs_s, *,
+            score_s, cpred_s, predw_s, succw_s, pslot_s,
+            path_s, aligsm_s, gcnt_s, regs_s,
+            minsucc_s, chw_s, sem, *,
             v: int, lp: int, d1: int, p: int, s_: int, a_: int,
             k: int, wb: int,
             match: int, mismatch: int, gap: int,
@@ -146,6 +149,17 @@ def _kernel(nlay_ref, bblen_ref,
     i = pl.program_id(0)
     nlay = nlay_ref[i]
     bbl = bblen_ref[i]
+
+    def stage_chw():
+        """Copy the staged packed char*256+weight rows into SMEM: the
+        merge/seed phases read row 0 per position, and a scalar SMEM
+        read is ~20 ns where each vector->scalar lane extraction costs
+        a VPU sync (~1 us) -- the round-3 merge bottleneck.  The copy
+        moves the whole (8, lp+256) staging block because DMA slices
+        must be 8-sublane aligned; rows 1-7 are ballast."""
+        cp = pltpu.make_async_copy(chw_v, chw_s, sem)
+        cp.start()
+        cp.wait()
     q = 128               # band-start quantum: 128-aligned lane slices
                           # are free; 64-offset slices cost a rotation
     tape = v + lp
@@ -155,6 +169,7 @@ def _kernel(nlay_ref, bblen_ref,
     gapf = jnp.float32(gap)
     cols_i = lax.broadcasted_iota(jnp.int32, (1, wb), 1)
     colsf = cols_i.astype(jnp.float32)
+    colsg = colsf * jnp.float32(gap)
     iota_p = lax.broadcasted_iota(jnp.int32, (1, p), 1)
     iota_s = lax.broadcasted_iota(jnp.int32, (1, s_), 1)
     iota_c128 = lax.broadcasted_iota(jnp.int32, (1, 128), 1)
@@ -179,10 +194,14 @@ def _kernel(nlay_ref, bblen_ref,
                                 iota_v0 - 1, -1)
     succs_v[:, :] = jnp.full((v, s_), -1, jnp.int32)
     succs_v[:, 0:1] = jnp.where(iota_v0 < bblm - 1, iota_v0 + 1, -1)
-    succanch_v[:, :] = jnp.full((v, s_), _INF32, jnp.int32)
-    succanch_v[:, 0:1] = jnp.where(iota_v0 < bblm - 1, iota_v0 + 1,
-                                   _INF32)
     chw_v[:, :] = jnp.zeros((8, lp + 256), jnp.int32)
+    chars_v[:, :] = jnp.zeros((8, lp + 256), jnp.int32)
+    # the pred-fold staging row: [0, wb) is overwritten per fold, the
+    # [wb, wb + N_SHIFT*q) tail stays -inf so a lagging pred's shifted
+    # window reads -inf beyond its band (replaces the pad+4-select
+    # fold with one store + one 128-aligned dynamic-lane load)
+    stage_v[0:1, :] = jnp.full((1, wb + _N_SHIFT * q), negf,
+                               jnp.float32)
 
     def init_bandq(j, _):
         bandq_s[j] = jnp.int32(-1)
@@ -214,19 +233,15 @@ def _kernel(nlay_ref, bblen_ref,
     def _():
         regs_s[0] = jnp.int32(FAIL_VCAP)
 
-    # stage char*256+weight at a STATIC sublane so the per-position
-    # window loads below have a supported addressing mode (dynamic
-    # sublane + dynamic lane in one load fails to lower) and each
-    # extraction pays ONE vector->scalar sync for both values
+    # stage char*256+weight in VMEM (the DP band load windows into it)
+    # and mirror it into SMEM (seed/merge read per position)
     chw_v[0:1, 0:lp] = seqs_ref[0, 0:1, :] * 256 + wts_ref[0, 0:1, :]
+    stage_chw()
 
     def chw_at(j):
-        """(char, weight) at dynamic position j via a 128-lane window
-        of the staged combined row."""
-        jb = (j // 128) * 128
-        win = chw_v[0:1, pl.ds(pl.multiple_of(jb, 128), 128)]
-        x = e11(jnp.sum(jnp.where(iota_c128 == (j - jb), win, 0),
-                        axis=1, keepdims=True))
+        """(char, weight) at dynamic position j: scalar SMEM reads of
+        the mirrored row, no VPU involvement."""
+        x = chw_s[0, j]
         return x // 256, x % 256
 
     def seed(j, prev_w):
@@ -238,10 +253,8 @@ def _kernel(nlay_ref, bblen_ref,
         glast_s[j] = j
         pcnt_s[j] = jnp.where(j > 0, 1, 0)
         scnt_s[j] = jnp.where(j + 1 < bbl, 1, 0)
-        predsm_s[j * 4] = j - 1
-        predsm_s[j * 4 + 1] = jnp.int32(-1)
-        predsm_s[j * 4 + 2] = jnp.int32(-1)
-        predsm_s[j * 4 + 3] = jnp.int32(-1)
+        minsucc_s[j] = jnp.where(j + 1 < bbl, j + 1, _INF32)
+        predsm_s[j * 8] = j - 1
         succsm_s[j * 4] = jnp.where(j + 1 < bbl, j + 1, -1)
 
         @pl.when(j > 0)
@@ -283,13 +296,14 @@ def _kernel(nlay_ref, bblen_ref,
             nseq_s[nid] = jnp.int32(0)
             glast_s[nid] = nid
             bandq_s[nid] = jnp.int32(-1)
+            # slot 0 must be initialized: a zero-pred node's traceback
+            # diag code still reads mirror slot 0 (cnt-bounded readers
+            # cover slots >= 1 only)
+            predsm_s[nid * 8] = jnp.int32(-1)
             pcnt_s[nid] = jnp.int32(0)
             scnt_s[nid] = jnp.int32(0)
             gcnt_s[nid] = jnp.int32(0)
-            predsm_s[nid * 4] = jnp.int32(-1)
-            predsm_s[nid * 4 + 1] = jnp.int32(-1)
-            predsm_s[nid * 4 + 2] = jnp.int32(-1)
-            predsm_s[nid * 4 + 3] = jnp.int32(-1)
+            minsucc_s[nid] = _INF32
             regs_s[2] = nid + 1
             insert_after(pos, nid)
 
@@ -341,9 +355,7 @@ def _kernel(nlay_ref, bblen_ref,
                 srow = vload(succs_v, u)
                 succs_v[pl.ds(u, 1), :] = jnp.where(iota_s == free, t,
                                                     srow)
-                rowa = vload(succanch_v, u)
-                succanch_v[pl.ds(u, 1), :] = jnp.where(
-                    iota_s == free, anch_s[t], rowa)
+                minsucc_s[u] = jnp.minimum(minsucc_s[u], anch_s[t])
                 preds_v[pl.ds(t, 1), :] = jnp.where(iota_p == pfree, u,
                                                     prow)
                 succw_s[u * s_ + free] = w
@@ -356,9 +368,9 @@ def _kernel(nlay_ref, bblen_ref,
                 def _():
                     succsm_s[u * 4 + free] = t
 
-                @pl.when(pfree < 4)
+                @pl.when(pfree < 8)
                 def _():
-                    predsm_s[t * 4 + pfree] = u
+                    predsm_s[t * 8 + pfree] = u
 
             @pl.when(jnp.logical_not(okk))
             def _():
@@ -369,73 +381,74 @@ def _kernel(nlay_ref, bblen_ref,
     def layer(d, _):
         @pl.when(regs_s[0] == 0)
         def _do_layer():
-            mrow = meta_ref[0, pl.ds(d, 1), :]      # [1, 8]
-            begin = mrow[0, 0]
-            end = mrow[0, 1]
-            fsp = mrow[0, 2]
-            m = mrow[0, 3]
+            begin = meta_ref[0, d, 0]
+            end = meta_ref[0, d, 1]
+            fsp = meta_ref[0, d, 2]
+            m = meta_ref[0, d, 3]
             regs_s[3] = regs_s[3] + jnp.where(m > 0, 1, 0)
-            # stage char*256+weight once per layer: the DP band slice
-            # and the merge extraction both window into this row
-            chw_v[0:1, 0:lp] = seqs_ref[0, pl.ds(d, 1), :] * 256 \
+            # stage chars (DP band loads) and char*256+weight (SMEM
+            # mirror for the merge) once per layer
+            chars_v[0:1, 0:lp] = seqs_ref[0, pl.ds(d, 1), :]
+            chw_v[0:1, 0:lp] = chars_v[0:1, 0:lp] * 256 \
                 + wts_ref[0, pl.ds(d, 1), :]
+            stage_chw()
 
-            # 1) list walk: subset ranks + per-rank sink flags
+            # 1+2) fused walk + banded DP: ONE pass over the topo list
+            # computes each in-subset node's row AND folds the sink
+            # scores inline.  Band placement is ANCHOR-based -- a
+            # node's expected query column scales with its backbone
+            # anchor -- so no pre-walk is needed to count subset ranks
+            # (the former separate walk cost ~0.24 us per listed node,
+            # ~25% of the kernel).  Anchors are non-decreasing along
+            # edges, so a predecessor's band never leads its
+            # successor's, preserving the dq >= 0 invariant the
+            # rank-based placement had.
             end_eff = jnp.where(fsp > 0, _INF32 - 1, end)
-
-            def wcond(c):
-                return c[0] >= 0
-
-            def wbody(c):
-                node, r = c
-                anc = anch_s[node]
-                in_sub = (fsp > 0) | ((anc >= begin) & (anc <= end))
-
-                @pl.when(in_sub)
-                def _():
-                    order_s[r] = node
-                    minanch = e11(jnp.min(vload(succanch_v, node),
-                                          axis=1, keepdims=True))
-                    sinkr_s[r] = jnp.where(minanch > end_eff, 1, 0)
-                return nxt_s[node], r + jnp.where(in_sub, 1, 0)
-
-            _, nrank = lax.while_loop(wcond, wbody,
-                                      (regs_s[1], jnp.int32(0)))
-            regs_s[4] = regs_s[4] + nrank
-
-            # 2) banded DP over subset ranks (same recurrence as
-            # poa.py _poa_kernel_banded, one window instead of a batch)
-            nr = jnp.maximum(nrank, 1)
             smax_q = (jnp.maximum(m + 1 - wb, 0) + q - 1) // q
+            span = jnp.maximum(end - begin, 1)
+            # q8 fixed-point band slope per subset rank: nr is the
+            # list length for full-span layers (their subset is the
+            # whole graph) and a backbone-density estimate for partial
+            # layers; one multiply+shift per rank replaces a dynamic
+            # divide (nvis <= v, slope < 2^18 only when nr_est is 1
+            # and m is at cap -- products stay inside int32)
+            nr_est = jnp.where(
+                fsp > 0, regs_s[2],
+                jnp.maximum(1, (span * regs_s[2]) // bblm))
+            slope_q8 = (m * 256) // jnp.maximum(nr_est, 1)
+            regs_s[6] = jnp.int32(-1)          # best sink node
+            regs_s[7] = jnp.int32(-_BIG)       # best sink score
 
-            def sqq(r):
-                # subtract q/2 (not wb/2): with quantum q the start
-                # rounds DOWN up to q-1 further, so centering on wb/2
-                # can leave a 1-column right margin; q/2 keeps both
-                # margins >= ~q/2 for wb = 2q
-                return jnp.clip(((r * m) // nr - (q // 2)) // q, 0,
-                                smax_q)
+            def slot_meta(pid, cnt, t):
+                """(epoch-valid, band-start) for one pred slot."""
+                be = bandq_s[jnp.clip(pid, 0, v - 1)]
+                valid = (t < cnt) & (pid >= 0) & ((be >> 8) == d)
+                return valid, jnp.where(valid, be & 255, 0)
 
-            def pred_fold(pid, sq_r):
+            def pred_fold(pid, valid, sqp, sq_r):
                 """One predecessor's H row realigned to this rank's
                 band, in vert space (u[c] = H_pred[s_r + c]); the diag
                 view is u shifted by one, applied once per rank after
-                the fold since the shift commutes with the max."""
-                be = bandq_s[jnp.maximum(pid, 0)]
-                valid = (pid >= 0) & ((be >> 8) == d)
-                g = ring_v[pl.ds(jnp.maximum(pid, 0), 1), :]
-                dq = sq_r - (be & 255)
-                gp = jnp.pad(g, ((0, 0), (0, _N_SHIFT * q)),
-                             constant_values=negf)
-                hv = jnp.full((1, wb), negf, jnp.float32)
-                for mm in range(_N_SHIFT):
-                    sel = valid & (dq == mm)
-                    hv = jnp.where(sel, gp[:, mm * q: mm * q + wb], hv)
+                the fold since the shift commutes with the max.
+
+                The row is staged into stage_v[0, :wb] and re-read at
+                lane offset dq*q (128-aligned, so the dynamic slice is
+                free); the staging tail stays -inf, covering the
+                shifted window's overhang.  One store + one load + one
+                select replaces the former pad + N_SHIFT selects."""
+                dq = sq_r - sqp
+                ok = valid & (dq >= 0) & (dq < _N_SHIFT)
+                dqc = jnp.clip(dq, 0, _N_SHIFT - 1)
+                stage_v[0:1, 0:wb] = ring_v[pl.ds(jnp.maximum(pid, 0),
+                                                  1), :]
+                hv = stage_v[0:1, pl.ds(pl.multiple_of(dqc * q, q),
+                                        wb)]
+                hv = jnp.where(ok, hv, negf)
                 # a predecessor whose band lags out of shift range
                 # cannot contribute; silently degrading would corrupt
                 # the consensus, so the window must fail to the CPU
                 # engine (the lockstep path's kcap reject analog)
-                bad = valid & ((dq < 0) | (dq >= _N_SHIFT))
+                bad = valid & jnp.logical_not(ok)
                 return hv, jnp.where(valid, 1, 0), bad
 
             def acc_update(hv, t):
@@ -444,142 +457,159 @@ def _kernel(nlay_ref, bblen_ref,
                 accs[0:1, :] = jnp.where(up, hv, a0)
                 arga[0:1, :] = jnp.where(up, t, arga[0:1, :])
 
-            def rank_body(r, _):
-                sq_r = sqq(r)
-                s_r = sq_r * q
-                node = order_s[r - 1]
-                cnt = pcnt_s[node]
-                # common case: 1 pred (chain node) -- fold slot 0
-                # unguarded straight into registers; the accs merge
-                # buffer and slots 1-3 only engage for cnt > 1
-                regs_s[8] = jnp.int32(0)       # nreal from slots 1-3
-                regs_s[9] = jnp.int32(0)       # nbad from slots 1-3
-                pid0 = jnp.where(cnt > 0, predsm_s[node * 4], -1)
-                hv0, nv0, bad0 = pred_fold(pid0, sq_r)
-
-                @pl.when(cnt > 1)
-                def _():
-                    accs[0:1, :] = hv0
-                    arga[0:1, :] = jnp.zeros((1, wb), jnp.int32)
-                    for t in range(1, 4):
-                        @pl.when(cnt > t)
-                        def _(t=t):
-                            pid = predsm_s[node * 4 + t]
-                            hv, nv, bad = pred_fold(pid, sq_r)
-                            acc_update(hv, t)
-                            regs_s[8] = regs_s[8] + nv
-                            regs_s[9] = regs_s[9] + \
-                                jnp.where(bad, 1, 0)
-
-                nreal = nv0 + regs_s[8]
-                nbad = jnp.where(bad0, 1, 0) + regs_s[9]
-
-                @pl.when(nbad > 0)
-                def _():
-                    regs_s[0] = jnp.int32(FAIL_KCAP)
-
-                @pl.when(cnt > 4)
-                def _deep():
-                    # rare: in-degree > 4, remaining slots from VMEM
-                    prow = vload(preds_v, node)
-
-                    def deep_step(t, nr2):
-                        pid = e11(jnp.sum(
-                            jnp.where(iota_p == t, prow, 0), axis=1,
-                            keepdims=True))
-                        hv, nv, bad = pred_fold(pid, sq_r)
-                        acc_update(hv, t)
-
-                        @pl.when(bad)
-                        def _():
-                            regs_s[0] = jnp.int32(FAIL_KCAP)
-                        return nr2 + nv
-
-                    nr2 = lax.fori_loop(4, cnt, deep_step,
-                                        jnp.int32(0))
-                    regs_s[5] = nr2
-
-                @pl.when(cnt <= 4)
-                def _():
-                    regs_s[5] = jnp.int32(0)
-                nreal = nreal + regs_s[5]
-
-                # no in-subset predecessor: virtual start row
-                # (poa_graph.hpp pred_rows empty -> [0]); in vert
-                # space the virtual row is exactly (s_r + c) * gap
-                novel = nreal == 0
-                vv = (s_r + cols_i).astype(jnp.float32) * gapf
-                multi = cnt > 1
-                accu = jnp.where(novel, vv,
-                                 jnp.where(multi, accs[0:1, :], hv0))
-                argu = jnp.where(novel | jnp.logical_not(multi), 0,
-                                 arga[0:1, :])
-
-                # this band's seq chars: one 128-aligned window load
-                # of the staged row (replaces a multi-way slice select)
-                sb = chw_v[0:1,
-                           pl.ds(pl.multiple_of(s_r, q), wb)] // 256
-                base_r = base_s[node]
-                # sub in u space: scored char at column c'+1 = seq
-                # position s_r + c'
-                j_u = s_r + cols_i
-                sub_u = jnp.where((j_u < m) & (sb == base_r), matchf,
+            def epilogue(node, s_r, accu, argu):
+                """Row finish shared by both in-degree branches: sub
+                scores, the three-way move max, the in-row gap chain,
+                direction codes, stores."""
+                # this band's seq chars: one 128-aligned window load;
+                # chars past the layer length are 0 pads and never
+                # equal a real base, so no explicit j < m mask
+                sb = chars_v[0:1, pl.ds(pl.multiple_of(s_r, q), wb)]
+                sub_u = jnp.where(sb == base_s[node], matchf,
                                   mismatchf)
-
                 dmax_u = accu + sub_u
                 vmax = accu + gapf
                 dmax = jnp.pad(dmax_u, ((0, 0), (1, 0)),
                                constant_values=negf)[:, :wb]
-                argd = jnp.pad(argu, ((0, 0), (1, 0)),
-                               constant_values=0)[:, :wb]
                 t_best = jnp.maximum(dmax, vmax)
-                x = t_best - colsf * gapf
+                x = t_best - colsg
                 sh = 1
                 while sh < wb:
                     x = jnp.maximum(
                         x, jnp.pad(x, ((0, 0), (sh, 0)),
                                    constant_values=negf)[:, :wb])
                     sh <<= 1
-                hr = x + colsf * gapf
+                hr = x + colsg
+                argd = jnp.pad(argu, ((0, 0), (1, 0)),
+                               constant_values=0)[:, :wb]
                 code = jnp.where(
                     dmax == hr, argd,
                     jnp.where(vmax == hr, argu + p,
                               2 * p)).astype(jnp.int32)
                 dirs[pl.ds(node, 1), :] = code
                 ring_v[pl.ds(node, 1), :] = hr
-                bandq_s[node] = (d << 8) | sq_r
-                return 0
 
-            lax.fori_loop(1, nrank + 1, rank_body, 0)
+            def dp_cond(c):
+                return c[0] >= 0
 
-            # sink fold after the loop: only sink ranks pay the
-            # vector->scalar score extraction
-            regs_s[6] = jnp.int32(-1)          # best sink node
-            regs_s[7] = jnp.int32(-_BIG)       # best score (int cast)
+            def dp_body(c):
+                node, nvis = c
+                anc = anch_s[node]
+                in_sub = (fsp > 0) | ((anc >= begin) & (anc <= end))
 
-            def sink_scan(r, _):
-                @pl.when(sinkr_s[r - 1] > 0)
+                @pl.when(in_sub)
                 def _():
-                    node = order_s[r - 1]
-                    s_r = (bandq_s[node] & 255) * q
-                    c_end = m - s_r
+                    cnt = pcnt_s[node]
+                    # rank-based band placement from the carried
+                    # in-subset counter: sq is monotone along the topo
+                    # list, so a successor's band never lags any
+                    # predecessor's (the dq >= 0 invariant), exactly
+                    # like the pre-fusion two-pass design
+                    sq_r = jnp.clip(
+                        (((nvis * slope_q8) >> 8) - (q // 2)) >> 7,
+                        0, smax_q)
+                    s_r = sq_r * q
+                    pid0 = jnp.where(cnt > 0, predsm_s[node * 8], -1)
+                    val0, sqp0 = slot_meta(pid0, cnt, 0)
+                    pid1 = predsm_s[node * 8 + 1]
+                    val1, sqp1 = slot_meta(pid1, cnt, 1)
+                    pid2 = predsm_s[node * 8 + 2]
+                    val2, sqp2 = slot_meta(pid2, cnt, 2)
+                    pid3 = predsm_s[node * 8 + 3]
+                    val3, sqp3 = slot_meta(pid3, cnt, 3)
+                    vvb = s_r.astype(jnp.float32) * gapf
 
-                    @pl.when(c_end < wb)
+                    regs_s[8] = jnp.int32(0)   # nreal slots 1+
+                    regs_s[9] = jnp.int32(0)   # nbad slots 1+
+                    hv0, nv0, bad0 = pred_fold(pid0, val0, sqp0, sq_r)
+
+                    @pl.when(cnt > 1)
                     def _():
-                        hrow = ring_v[pl.ds(node, 1), :]
-                        ccl = jnp.clip(c_end, 0, wb - 1)
-                        s_end = jnp.sum(jnp.where(
-                            cols_i == ccl, hrow,
-                            jnp.float32(0))).astype(jnp.int32)
+                        accs[0:1, :] = hv0
+                        arga[0:1, :] = jnp.zeros((1, wb), jnp.int32)
+                        for t, (pid, val, sqp) in (
+                                (1, (pid1, val1, sqp1)),
+                                (2, (pid2, val2, sqp2)),
+                                (3, (pid3, val3, sqp3))):
+                            @pl.when(cnt > t)
+                            def _(t=t, pid=pid, val=val, sqp=sqp):
+                                hv, nv, bad = pred_fold(pid, val, sqp,
+                                                        sq_r)
+                                acc_update(hv, t)
+                                regs_s[8] = regs_s[8] + nv
+                                regs_s[9] = regs_s[9] + \
+                                    jnp.where(bad, 1, 0)
 
-                        @pl.when(s_end > regs_s[7])
+                        @pl.when(cnt > 4)
+                        def _deep():
+                            prow = vload(preds_v, node)
+
+                            def deep_step(t, nr2):
+                                pid = e11(jnp.sum(
+                                    jnp.where(iota_p == t, prow, 0),
+                                    axis=1, keepdims=True))
+                                val, sqp = slot_meta(pid, cnt, t)
+                                hv, nv, bad = pred_fold(pid, val, sqp,
+                                                        sq_r)
+                                acc_update(hv, t)
+
+                                @pl.when(bad)
+                                def _():
+                                    regs_s[0] = jnp.int32(FAIL_KCAP)
+                                return nr2 + nv
+
+                            regs_s[8] = regs_s[8] + lax.fori_loop(
+                                4, cnt, deep_step, jnp.int32(0))
+
+                    nreal = nv0 + regs_s[8]
+
+                    @pl.when((jnp.where(bad0, 1, 0) + regs_s[9]) > 0)
+                    def _():
+                        regs_s[0] = jnp.int32(FAIL_KCAP)
+
+                    novel = nreal == 0
+                    multi = cnt > 1
+                    accu = jnp.where(novel, colsg + vvb,
+                                     jnp.where(multi, accs[0:1, :],
+                                               hv0))
+                    argu = jnp.where(novel | jnp.logical_not(multi),
+                                     0, arga[0:1, :])
+                    epilogue(node, s_r, accu, argu)
+
+                    bandq_s[node] = (d << 8) | sq_r
+
+                    # inline sink fold: only true subset sinks pay the
+                    # vector->scalar score extraction
+                    @pl.when(minsucc_s[node] > end_eff)
+                    def _sink():
+                        c_end = m - s_r
+
+                        @pl.when(c_end < wb)
                         def _():
-                            regs_s[7] = s_end
-                            regs_s[6] = node
-                return 0
+                            hrow = ring_v[pl.ds(node, 1), :]
+                            ccl = jnp.clip(c_end, 0, wb - 1)
+                            s_end = jnp.sum(jnp.where(
+                                cols_i == ccl, hrow,
+                                jnp.float32(0))).astype(jnp.int32)
 
-            lax.fori_loop(1, nrank + 1, sink_scan, 0)
+                            @pl.when(s_end > regs_s[7])
+                            def _():
+                                regs_s[7] = s_end
+                                regs_s[6] = node
+                return nxt_s[node], nvis + jnp.where(in_sub, 1, 0)
+
+            _, nvis = lax.while_loop(dp_cond, dp_body,
+                                     (regs_s[1], jnp.int32(0)))
+            regs_s[4] = regs_s[4] + nvis
             best_node = regs_s[6]
+
+            # no subset sink landed within band reach of the layer
+            # end (the nr estimate misplaced the bands): tracing back
+            # from node -1 would fabricate an all-new path, so the
+            # window must fail to the CPU engine instead
+            @pl.when((best_node < 0) & (nvis > 0))
+            def _():
+                regs_s[0] = jnp.int32(FAIL_KCAP)
 
 
             # 3) traceback -> reversed path in path_s, packed as
@@ -604,15 +634,15 @@ def _kernel(nlay_ref, bblen_ref,
                                 0, p - 1)
 
                 def mirror(_):
-                    return predsm_s[nodec * 4 + jnp.clip(slot, 0, 3)]
+                    return predsm_s[nodec * 8 + jnp.clip(slot, 0, 7)]
 
                 def deep(_):
                     prow = vload(preds_v, nodec)
                     return jnp.sum(jnp.where(iota_p == slot, prow, 0))
 
-                pid = lax.cond(slot < 4, mirror, deep, 0)
+                pid = lax.cond(slot < 8, mirror, deep, 0)
                 pvalid = (pid >= 0) & \
-                    ((bandq_s[jnp.maximum(pid, 0)] >> 8) == d)
+                    ((bandq_s[jnp.clip(pid, 0, v - 1)] >> 8) == d)
                 pnode = jnp.where(pvalid, pid, -1)
                 en = jnp.where(take, node, -1)
                 es = jnp.where(is_vert, -1, j - 1)
@@ -775,7 +805,7 @@ def _kernel(nlay_ref, bblen_ref,
                 bu, bw = carry
 
                 def mirror(_):
-                    return predsm_s[node * 4 + jnp.clip(t, 0, 3)]
+                    return predsm_s[node * 8 + jnp.clip(t, 0, 7)]
 
                 def deep(_):
                     prow = vload(preds_v, node)
@@ -783,7 +813,7 @@ def _kernel(nlay_ref, bblen_ref,
                         jnp.where(iota_p == t, prow, 0), axis=1,
                         keepdims=True))
 
-                pid = lax.cond(t < 4, mirror, deep, 0)
+                pid = lax.cond(t < 8, mirror, deep, 0)
                 w = predw_s[node * p + t]
                 sc = score_s[jnp.maximum(pid, 0)]
                 bsc = score_s[jnp.maximum(bu, 0)]
@@ -798,9 +828,7 @@ def _kernel(nlay_ref, bblen_ref,
                 best_u >= 0,
                 score_s[jnp.maximum(best_u, 0)] + best_w, 0)
             cpred_s[node] = best_u
-            minanch = e11(jnp.min(vload(succanch_v, node), axis=1,
-                                  keepdims=True))
-            is_sink = minanch >= _INF32
+            is_sink = minsucc_s[node] >= _INF32
             better = is_sink & (
                 (best_sink < 0) |
                 (score_s[node] > score_s[jnp.maximum(best_sink, 0)]))
@@ -891,7 +919,7 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
             pl.BlockSpec((1, d1, lp), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, d1, 8), lambda i, *_: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
+                         memory_space=pltpu.SMEM),
         ],
         out_specs=(
             pl.BlockSpec((1, v, 1), lambda i, *_: (i, 0, 0),
@@ -902,12 +930,13 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
         scratch_shapes=[
             pltpu.VMEM((v, p), jnp.int32),       # preds
             pltpu.VMEM((v, s_), jnp.int32),      # succs
-            pltpu.VMEM((v, s_), jnp.int32),      # succanch
+            pltpu.VMEM((1, wb + _N_SHIFT * 128), jnp.float32),  # stage
             pltpu.VMEM((v, wb), jnp.float32),    # ring (node-indexed)
             pltpu.VMEM((v, wb), jnp.int32),      # dirs (node-indexed)
             pltpu.VMEM((8, wb), jnp.float32),    # accs
             pltpu.VMEM((8, wb), jnp.int32),      # arga
             pltpu.VMEM((8, lp + 256), jnp.int32),  # staged chr*256+wt
+            pltpu.VMEM((8, lp + 256), jnp.int32),  # staged chars only
             pltpu.SMEM((v,), jnp.int32),         # base
             pltpu.SMEM((v,), jnp.int32),         # anchor
             pltpu.SMEM((v,), jnp.int32),         # nseqs
@@ -916,10 +945,9 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
             pltpu.SMEM((v,), jnp.int32),         # band (epoch<<8|sq)
             pltpu.SMEM((v,), jnp.int32),         # pred count
             pltpu.SMEM((v,), jnp.int32),         # succ count
-            pltpu.SMEM((4 * v,), jnp.int32),     # pred id mirror
+            pltpu.SMEM((8 * v,), jnp.int32),     # pred id mirror
             pltpu.SMEM((4 * v,), jnp.int32),     # succ id mirror
             pltpu.SMEM((v,), jnp.int32),         # order
-            pltpu.SMEM((v,), jnp.int32),         # sink-by-rank
             pltpu.SMEM((v,), jnp.int32),         # consensus score
             pltpu.SMEM((v,), jnp.int32),         # consensus pred
             pltpu.SMEM((v * p,), jnp.int32),     # pred weights
@@ -929,6 +957,9 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
             pltpu.SMEM((v * a_,), jnp.int32),    # aligned-group ids
             pltpu.SMEM((v,), jnp.int32),         # aligned-group count
             pltpu.SMEM((12,), jnp.int32),        # regs
+            pltpu.SMEM((v,), jnp.int32),         # min succ anchor
+            pltpu.SMEM((8, lp + 256), jnp.int32),  # chw SMEM mirror
+            pltpu.SemaphoreType.DMA,             # chw staging sem
         ],
     )
     return pl.pallas_call(
