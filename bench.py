@@ -246,14 +246,18 @@ def scale_bench():
 
 
 def mega_bench():
-    """Megabase-scale workload (opt-in: RACON_TPU_BENCH_MEGA=1): a
-    4.6 Mb / 30x synthetic, the E. coli-class analog of the
-    reference's CI scale test (ci/gpu/cuda_test.sh:25-33, ~4.6 Mb ONT
-    polish).  This is where megabatch utilization, HBM budgeting and
-    the hybrid split get stressed; measured numbers are recorded in
-    BASELINE.md.  Off by default: the CPU reference leg alone runs for
-    several minutes."""
-    if os.environ.get("RACON_TPU_BENCH_MEGA", "0") != "1":
+    """Megabase-scale workload: a 4.6 Mb / 30x synthetic, the
+    E. coli-class analog of the reference's CI scale test
+    (ci/gpu/cuda_test.sh:25-33, ~4.6 Mb ONT polish).  This is where
+    megabatch utilization, HBM budgeting and the hybrid split get
+    stressed.  Default ON on TPU backends so the driver-captured BENCH
+    files carry the mega regression surface; several minutes per leg
+    (RACON_TPU_BENCH_MEGA=0 disables, RACON_TPU_BENCH_MEGA_CPU=0
+    skips just the CPU reference leg)."""
+    import jax
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if os.environ.get("RACON_TPU_BENCH_MEGA",
+                      "1" if on_tpu else "0") != "1":
         return {}
     import tempfile
 
@@ -278,25 +282,38 @@ def mega_bench():
             out = pol.polish(True)
             return time.monotonic() - t0, out, pol
 
-        tpu_cold, _, _ = run(1, 1)
+        # one TPU leg (compiles shared with the scale leg via the
+        # persistent cache) + one CPU reference leg
         tpu_wall, tpu_out, tpol = run(1, 1)
         d_tpu = cpu.edit_distance(tpu_out[0].data, truth)
-        cpu_wall, cpu_out, _ = run(0, 0)
-        d_cpu = cpu.edit_distance(cpu_out[0].data, truth)
         rejects = sum(tpol.poa_reject_counts.values())
-        log(f"[bench] mega (4.6Mb, 30x synthetic): CPU {cpu_wall:.1f}s"
-            f" (dist {d_cpu}), TPU {tpu_wall:.1f}s warm /"
-            f" {tpu_cold:.1f}s cold (dist {d_tpu}), speedup"
-            f" {cpu_wall / tpu_wall:.2f}x, {rejects} POA rejects")
-        return {
-            "mega_tpu_cold_s": round(tpu_cold, 3),
-            "mega_cpu_wall_s": round(cpu_wall, 3),
+        dev_windows = tpol.poa_device_windows
+        total_windows = tpol.poa_eligible_windows
+        out = {
             "mega_tpu_wall_s": round(tpu_wall, 3),
-            "mega_speedup": round(cpu_wall / tpu_wall, 3),
             "mega_tpu_edit_distance": int(d_tpu),
-            "mega_cpu_edit_distance": int(d_cpu),
             "mega_poa_rejects": int(rejects),
+            "mega_device_window_share": round(
+                dev_windows / max(total_windows, 1), 3),
         }
+        if os.environ.get("RACON_TPU_BENCH_MEGA_CPU", "1") == "1":
+            cpu_wall, cpu_out, _ = run(0, 0)
+            d_cpu = cpu.edit_distance(cpu_out[0].data, truth)
+            out.update({
+                "mega_cpu_wall_s": round(cpu_wall, 3),
+                "mega_speedup": round(cpu_wall / tpu_wall, 3),
+                "mega_cpu_edit_distance": int(d_cpu),
+            })
+            log(f"[bench] mega (4.6Mb, 30x synthetic): CPU "
+                f"{cpu_wall:.1f}s (dist {d_cpu}), TPU {tpu_wall:.1f}s"
+                f" (dist {d_tpu}), speedup {cpu_wall / tpu_wall:.2f}x,"
+                f" {rejects} POA rejects, device share"
+                f" {out['mega_device_window_share']:.0%}")
+        else:
+            log(f"[bench] mega (4.6Mb, 30x synthetic): TPU "
+                f"{tpu_wall:.1f}s (dist {d_tpu}), {rejects} POA "
+                "rejects (CPU leg skipped)")
+        return out
 
 
 if __name__ == "__main__":

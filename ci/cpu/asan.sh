@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# AddressSanitizer lane (reference analog: the meson
+# -Db_sanitize=address debug configuration driven by the reference's
+# `make debug`): build the native CPU engines with ASan and run the
+# native-engine test files against that library.  The Python
+# interpreter itself is not ASan-instrumented, so the runtime is
+# LD_PRELOADed; leak checking is disabled because CPython's arena
+# allocator reports benign leaks at interpreter exit.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+DEBUG=1 ci/common/build.sh
+ASAN_RT="$(g++ -print-file-name=libasan.so)"
+RACON_TPU_NATIVE_LIB="$PWD/racon_tpu/native/debug/libracon_native.so" \
+LD_PRELOAD="$ASAN_RT" \
+ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
+JAX_PLATFORMS=cpu \
+python -m pytest -q -x tests/test_native_align.py tests/test_native_poa.py
+echo "ASAN CI PASS"

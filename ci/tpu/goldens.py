@@ -14,11 +14,23 @@ Goldens:
   tests/golden/sample_tpu.fasta     sample contig polish (-c 1
                                     --tpualigner-batches 1, m5/x-4/g-8)
   tests/golden/scale300k_tpu.fasta  300 kb / 15x seeded synthetic
+  tests/golden/mega4m6_tpu.fasta    4.6 Mb / 30x seeded synthetic (the
+                                    E. coli-class analog of the
+                                    reference's 2.6 MB golden; skip
+                                    with RACON_TPU_CI_MEGA=0)
 """
 
 import os
 import sys
 import tempfile
+
+# pin the hybrid-split rates to the CI constants: golden bytes are a
+# function of the split, which must not depend on this machine's
+# calibration state (racon_tpu/utils/calibrate.py)
+os.environ.setdefault("RACON_TPU_RATE_POA_DEV", "0.30")
+os.environ.setdefault("RACON_TPU_RATE_POA_CPU", "2.0")
+os.environ.setdefault("RACON_TPU_RATE_ALIGN_DEV", "1100")
+os.environ.setdefault("RACON_TPU_RATE_ALIGN_CPU", "4.0")
 
 REPO = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -57,6 +69,15 @@ def outputs():
             tmp, genome_len=300_000, coverage=15, read_len=8000,
             seed=7)
         yield "scale300k_tpu.fasta", polish(reads, paf, draft)
+    if os.environ.get("RACON_TPU_CI_MEGA", "1") != "0":
+        # megabase golden: several minutes of real polishing, exactly
+        # like the reference's full-scale CI diff (ci/gpu/cuda_test.sh)
+        with tempfile.TemporaryDirectory(
+                prefix="racon_golden_mega_") as tmp:
+            reads, paf, draft = simulate.simulate(
+                tmp, genome_len=4_600_000, coverage=30,
+                read_len=10_000, seed=11)
+            yield "mega4m6_tpu.fasta", polish(reads, paf, draft)
 
 
 def main():

@@ -13,6 +13,11 @@ set -euo pipefail
 cd "$(dirname "$0")/../.."
 ci/common/build.sh
 DATA=/root/reference/test/data
+# pin the hybrid-split rates: the committed byte goldens hold for this
+# exact split, independent of any machine calibration state
+# (racon_tpu/utils/calibrate.py; env pins take precedence)
+export RACON_TPU_RATE_POA_DEV=0.30 RACON_TPU_RATE_POA_CPU=2.0
+export RACON_TPU_RATE_ALIGN_DEV=1100 RACON_TPU_RATE_ALIGN_CPU=4.0
 ARGS="-t 8 -m 5 -x -4 -g -8 -c 1 --tpualigner-batches 1"
 python -m racon_tpu.cli $ARGS \
     "$DATA/sample_reads.fastq.gz" "$DATA/sample_overlaps.paf.gz" \

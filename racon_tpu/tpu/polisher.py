@@ -92,6 +92,10 @@ class TPUPolisher(Polisher):
         self.align_cells = 0
         self.poa_cells = 0
         self.poa_reject_counts = {}
+        # hybrid observability: windows consensused on device vs total
+        # device-eligible (>= 3 sequences) windows
+        self.poa_device_windows = 0
+        self.poa_eligible_windows = 0
         self.stage_walls = {}
         from racon_tpu.utils.xla_cache import enable_compilation_cache
         enable_compilation_cache()
@@ -194,6 +198,8 @@ class TPUPolisher(Polisher):
             if len(w.sequences) < 3:
                 w.consensus = w.sequences[0]
         eligible.sort(key=lambda i: -len(self.windows[i].sequences))
+        self.poa_eligible_windows = len(eligible)
+        self.poa_device_windows = 0
 
         # hybrid execution: the host cores are an engine too, running
         # the native POA CONCURRENTLY with the device megabatches --
@@ -214,12 +220,26 @@ class TPUPolisher(Polisher):
         #     faster when the engines' relative rates are unknown, at
         #     the price of run-to-run output variation.
         import threading
+        import time as _time
         from collections import deque
+
+        from racon_tpu.utils import calibrate
 
         lock = threading.Lock()
         n_workers = self._tail_workers("RACON_TPU_POA_DEVICE_ONLY")
         steal = bool(os.environ.get("RACON_TPU_STEAL")) and n_workers
         work = deque(eligible)
+        # per-window cost units depth * (1 + depth/48) * (len/500) --
+        # superlinear in depth because inserts grow the graph -- feed
+        # both the split model and the in-run rate measurement
+        unit_of = {}
+        for i in eligible:
+            w0 = self.windows[i]
+            depth = min(len(w0.sequences) - 1,
+                        self.MAX_DEPTH_PER_WINDOW)
+            unit_of[i] = depth * (1 + depth / 48.0) \
+                * (len(w0.sequences[0]) / 500.0)
+        meas = {"dev": [], "cpu_w": 0.0, "cpu_u": 0.0}
         if steal or not n_workers:
             dev_left = len(eligible)     # device may reach everything
         elif "RACON_TPU_POA_SPLIT" in os.environ:
@@ -229,25 +249,20 @@ class TPUPolisher(Polisher):
                  for i in eligible],
                 float(os.environ["RACON_TPU_POA_SPLIT"]))
         else:
-            # deterministic rate-model argmin (like the align stage):
-            # per-window cost unit depth * (1 + depth/48) * (len/500)
-            # — superlinear in depth because inserts grow the graph —
-            # at measured r3 rates ~0.3 us/unit on one chip and
-            # ~2 us/unit per CPU worker.  A fixed share (r2's 0.62)
-            # overloaded the device ~3x on deep megabase workloads.
-            units = []
-            for i in eligible:
-                w0 = self.windows[i]
-                depth = min(len(w0.sequences) - 1,
-                            self.MAX_DEPTH_PER_WINDOW)
-                units.append(depth * (1 + depth / 48.0)
-                             * (len(w0.sequences[0]) / 500.0))
-            dev_left = _rate_split([u * 0.30 / n_dev for u in units],
-                                   [u * 2.0 / n_workers
-                                    for u in units])
+            # deterministic rate-model argmin (like the align stage)
+            # at SELF-CALIBRATED us/unit rates: measured on this
+            # machine by a previous run and persisted next to the XLA
+            # cache (r3-hardware defaults until then; env pins for
+            # golden CI configs) -- racon_tpu/utils/calibrate.py
+            r_dev, r_cpu, r_src = calibrate.get_rates(
+                "poa", n_dev, 0.30, 2.0)
+            dev_left = _rate_split(
+                [unit_of[i] * r_dev / n_dev for i in eligible],
+                [unit_of[i] * r_cpu / n_workers for i in eligible])
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::polish] poa split: device "
-                f"{dev_left}/{len(eligible)} windows")
+                f"{dev_left}/{len(eligible)} windows "
+                f"({r_src} rates {r_dev:.2f}/{r_cpu:.2f})")
 
         def cpu_worker():
             while True:
@@ -255,8 +270,12 @@ class TPUPolisher(Polisher):
                     if len(work) <= (0 if steal else dev_left):
                         return
                     i = work.pop()
+                t1 = _time.monotonic()
                 flags[i] = self.windows[i].generate_consensus(
                     self.engine, self.trim)
+                with lock:
+                    meas["cpu_w"] += _time.monotonic() - t1
+                    meas["cpu_u"] += unit_of[i]
 
         workers = [self._pool.submit(cpu_worker)
                    for _ in range(n_workers)]
@@ -274,14 +293,18 @@ class TPUPolisher(Polisher):
             if not idxs:
                 break
             batch = [self.windows[i] for i in idxs]
+            t1 = _time.monotonic()
             results = engine.consensus_batch(batch, self.trim,
                                              pool=self._pool)
+            meas["dev"].append((_time.monotonic() - t1,
+                                sum(unit_of[i] for i in idxs)))
             for i, (cons, ok) in zip(idxs, results):
                 if cons is None:
                     failed.append(i)
                 else:
                     self.windows[i].consensus = cons
                     flags[i] = ok
+                    self.poa_device_windows += 1
             self.logger.bar("[racon_tpu::TPUPolisher::polish] generating"
                             " consensus (device)")
         for fut in workers:
@@ -306,6 +329,16 @@ class TPUPolisher(Polisher):
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::polish] skipped "
                 f"{engine.n_skipped_layers} over-long layer(s)")
+        # drop the first device dispatch: it pays the one-time kernel
+        # trace/compile and would overstate the device cost ~2-3x; a
+        # single-dispatch run (the 47 kb sample) simply doesn't
+        # calibrate -- megabase-class runs have many megabatches
+        dev_w = sum(w for w, _ in meas["dev"][1:])
+        dev_u = sum(u for _, u in meas["dev"][1:])
+        if dev_u > 0 and meas["cpu_u"] > 0:
+            calibrate.store_rates(
+                "poa", n_dev, dev_w * 1e6 * n_dev / dev_u,
+                meas["cpu_w"] * 1e6 / meas["cpu_u"])
         self.poa_cells += engine.cells
         self.poa_reject_counts = dict(engine.reject_counts)
         self.poa_phase_walls = dict(engine.phase_walls)
@@ -405,10 +438,9 @@ class TPUPolisher(Polisher):
         from racon_tpu.utils.tuning import pow2_at_least
         return pow2_at_least(n, 512)
 
-    # measured r3 engine rates backing the deterministic hybrid split:
-    # the 8-stacked Pallas kernel runs 0.57-0.96 us/row including the
-    # traceback pass (band 2048-8192); CPU WFA on sample-divergence
-    # overlaps costs ~4 ns x dim^2 (O(N + D^2) with D ~ 20-35% of N)
+    # DEFAULT hybrid-split rates (r3 hardware measurements), used only
+    # until the first run self-calibrates and persists machine rates
+    # (racon_tpu/utils/calibrate.py); RACON_TPU_RATE_ALIGN_* pins them
     DEV_NS_PER_ROW = 1100
     CPU_NS_PER_CELL = 4.0
 
@@ -444,13 +476,18 @@ class TPUPolisher(Polisher):
         affects the scan/POA hybrid loops (this path dispatches the
         whole device share at once, so there is nothing to steal)."""
         import threading
+        import time as _time
         from collections import deque
 
         from racon_tpu.ops import cpu as cpu_ops
+        from racon_tpu.utils import calibrate
 
         n_workers = self._tail_workers("RACON_TPU_ALIGN_DEVICE_ONLY")
         dims = [d for d, _ in pending]
         n_dev = len(self.mesh.devices)
+        r_dev, r_cpu, r_src = calibrate.get_rates(
+            "align", n_dev, float(self.DEV_NS_PER_ROW),
+            float(self.CPU_NS_PER_CELL))
         if not n_workers:
             cut = len(pending)
         elif "RACON_TPU_ALIGN_SPLIT" in os.environ:
@@ -459,13 +496,13 @@ class TPUPolisher(Polisher):
                 dims, float(os.environ["RACON_TPU_ALIGN_SPLIT"]))
         else:
             cut = _rate_split(
-                [d * self.DEV_NS_PER_ROW / n_dev for d in dims],
-                [self.CPU_NS_PER_CELL * d * d / n_workers
-                 for d in dims])
+                [d * r_dev / n_dev for d in dims],
+                [r_cpu * d * d / n_workers for d in dims])
 
         work = deque(pending[cut:])
         lock = threading.Lock()
         n_cpu_done = 0
+        meas = {"cpu_w": 0.0, "cpu_u": 0.0}
 
         def cpu_worker():
             nonlocal n_cpu_done
@@ -473,18 +510,38 @@ class TPUPolisher(Polisher):
                 with lock:
                     if not work:
                         return
-                    _, o = work.pop()
+                    d, o = work.pop()
                     n_cpu_done += 1
+                t1 = _time.monotonic()
                 o.find_breaking_points(self.sequences,
                                        self.window_length,
                                        aligner=cpu_ops.align)
+                with lock:
+                    meas["cpu_w"] += _time.monotonic() - t1
+                    meas["cpu_u"] += float(d) * d
 
         workers = [self._pool.submit(cpu_worker)
                    for _ in range(n_workers)]
         if cut:
+            self._align_disp = []
             self._pallas_align([o for _, o in pending[:cut]])
         for f in workers:
             f.result()
+        if cut and meas["cpu_u"] > 0:
+            # drop the first dispatch per band rung (one-time
+            # trace/compile pollutes it); single-chunk runs skip
+            # calibration
+            by_rung = {}
+            for wb_r, w, rows in self._align_disp:
+                by_rung.setdefault(wb_r, []).append((w, rows))
+            dev_w = sum(w for ch in by_rung.values()
+                        for w, _ in ch[1:])
+            dev_rows = sum(r for ch in by_rung.values()
+                           for _, r in ch[1:])
+            if dev_rows > 0:
+                calibrate.store_rates(
+                    "align", n_dev, dev_w * 1e9 * n_dev / dev_rows,
+                    meas["cpu_w"] * 1e9 / meas["cpu_u"])
         if n_cpu_done:
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::align] cpu-aligned "
@@ -623,10 +680,16 @@ class TPUPolisher(Polisher):
             still = set()
             for c0 in range(0, len(idx), max_b):
                 sub = idx[c0:c0 + max_b]
+                import time as _time
+                t1 = _time.monotonic()
                 moves, lens, dists = align_pallas.align_batch(
                     [queries[i] for i in sub],
                     [targets[i] for i in sub],
                     bd, bd, wb, mesh=self.mesh)
+                if hasattr(self, "_align_disp"):
+                    self._align_disp.append(
+                        (wb, _time.monotonic() - t1,
+                         float(sum(len(queries[i]) for i in sub))))
                 self.align_cells += sum(len(queries[i])
                                         for i in sub) * wb
                 for k, i in enumerate(sub):

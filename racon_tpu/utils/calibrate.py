@@ -1,0 +1,112 @@
+"""Self-calibrating hybrid-split rates.
+
+The polisher schedules each hybrid stage (device POA / device align)
+with a deterministic rate-model argmin over per-item costs (see
+``TPUPolisher._rate_split``).  The rates that feed the model were
+frozen r3 hardware measurements; on any other chip/host ratio a frozen
+rate is deterministic-but-wrong.  This module makes them measured:
+
+* every run instruments both engines (work units / busy wall) and
+  persists the measured rates ONCE per (platform, n_dev, n_cpu) next
+  to the XLA compilation cache — the analog of cudapolisher's
+  free-memory-driven batch sizing (src/cuda/cudapolisher.cpp:174-181,
+  231-242), done for throughput rates;
+* later runs load the persisted rates, so the chosen split is a pure
+  function of the input again and output bytes are reproducible
+  across runs on a machine once calibrated (write-once: set
+  RACON_TPU_RECALIBRATE=1 to refresh after a hardware change);
+* ``RACON_TPU_RATE_<STAGE>_{DEV,CPU}`` env overrides pin the rates
+  exactly — CI's golden configs use these so committed goldens stay
+  valid on any hardware;
+* within one process the first lookup is cached, so repeated polishes
+  in-process (the bench's determinism check) always agree even on the
+  very first, yet-uncalibrated run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+_lock = threading.Lock()
+_proc_cache: dict = {}
+
+
+def _calib_path() -> str:
+    base = os.environ.get("RACON_TPU_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "racon_tpu", "xla")
+    if not base or base.startswith("~"):
+        base = os.path.join("/tmp", "racon_tpu")
+    return os.path.join(os.path.dirname(base.rstrip("/")) or base,
+                        "calibration.json")
+
+
+def _machine_key(n_dev: int) -> str:
+    try:
+        import jax
+        plat = jax.devices()[0].platform
+    except Exception:
+        plat = "unknown"
+    return f"{plat}-{n_dev}dev-{os.cpu_count()}cpu"
+
+
+def get_rates(stage: str, n_dev: int, default_dev: float,
+              default_cpu: float) -> tuple:
+    """(dev_rate, cpu_rate, source) for a hybrid stage.  Precedence:
+    env pin > process cache > persisted calibration > defaults.  The
+    result is cached per process so every polish in one process uses
+    identical rates (split determinism within a run)."""
+    key = (stage, n_dev)
+    with _lock:
+        if key in _proc_cache:
+            return _proc_cache[key]
+        env_dev = os.environ.get(f"RACON_TPU_RATE_{stage.upper()}_DEV")
+        env_cpu = os.environ.get(f"RACON_TPU_RATE_{stage.upper()}_CPU")
+        if env_dev and env_cpu:
+            out = (float(env_dev), float(env_cpu), "env")
+        else:
+            out = (default_dev, default_cpu, "default")
+            if not os.environ.get("RACON_TPU_RECALIBRATE"):
+                try:
+                    with open(_calib_path()) as f:
+                        data = json.load(f)
+                    ent = data.get(_machine_key(n_dev), {}).get(stage)
+                    if ent:
+                        out = (float(ent["dev"]), float(ent["cpu"]),
+                               "calibrated")
+                except Exception:
+                    pass
+        _proc_cache[key] = out
+        return out
+
+
+def store_rates(stage: str, n_dev: int, dev_rate: float,
+                cpu_rate: float) -> None:
+    """Persist measured rates (write-once per machine key + stage;
+    RACON_TPU_RECALIBRATE=1 overwrites).  Never raises."""
+    if not (dev_rate > 0 and cpu_rate > 0):
+        return
+    try:
+        path = _calib_path()
+        mkey = _machine_key(n_dev)
+        with _lock:
+            data = {}
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except Exception:
+                pass
+            ent = data.setdefault(mkey, {})
+            if stage in ent and \
+                    not os.environ.get("RACON_TPU_RECALIBRATE"):
+                return
+            ent[stage] = {"dev": round(dev_rate, 4),
+                          "cpu": round(cpu_rate, 4)}
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=1)
+            os.replace(tmp, path)
+    except Exception:
+        pass

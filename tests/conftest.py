@@ -14,6 +14,15 @@ does this.
 import os
 import sys
 
+# pin the hybrid-split rates for every test: outputs pinned by tests
+# are a function of the split, which must not depend on this machine's
+# persisted calibration state (racon_tpu/utils/calibrate.py); tests of
+# the calibration module itself monkeypatch these away
+os.environ.setdefault("RACON_TPU_RATE_POA_DEV", "0.30")
+os.environ.setdefault("RACON_TPU_RATE_POA_CPU", "2.0")
+os.environ.setdefault("RACON_TPU_RATE_ALIGN_DEV", "1100")
+os.environ.setdefault("RACON_TPU_RATE_ALIGN_CPU", "4.0")
+
 if os.environ.get("RACON_TPU_TEST_PLATFORM", "cpu") == "cpu":
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,80 @@
+"""Self-calibrating hybrid-split rates (racon_tpu/utils/calibrate.py).
+
+The split model's rates resolve env pin > process cache > persisted
+calibration > defaults; persistence is write-once per machine key.
+"""
+
+import json
+import os
+
+import pytest
+
+from racon_tpu.utils import calibrate
+
+
+@pytest.fixture()
+def calib_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("RACON_TPU_CACHE_DIR", str(tmp_path / "xla"))
+    monkeypatch.delenv("RACON_TPU_RECALIBRATE", raising=False)
+    for v in ("RACON_TPU_RATE_POA_DEV", "RACON_TPU_RATE_POA_CPU",
+              "RACON_TPU_RATE_ALIGN_DEV", "RACON_TPU_RATE_ALIGN_CPU"):
+        monkeypatch.delenv(v, raising=False)
+    calibrate._proc_cache.clear()
+    yield tmp_path
+    calibrate._proc_cache.clear()
+
+
+def test_defaults_when_uncalibrated(calib_dir):
+    dev, cpu, src = calibrate.get_rates("poa", 1, 0.30, 2.0)
+    assert (dev, cpu, src) == (0.30, 2.0, "default")
+
+
+def test_env_pin_wins(calib_dir, monkeypatch):
+    monkeypatch.setenv("RACON_TPU_RATE_POA_DEV", "0.11")
+    monkeypatch.setenv("RACON_TPU_RATE_POA_CPU", "3.5")
+    calibrate.store_rates("poa", 1, 9.9, 9.9)
+    dev, cpu, src = calibrate.get_rates("poa", 1, 0.30, 2.0)
+    assert (dev, cpu, src) == (0.11, 3.5, "env")
+
+
+def test_store_then_load_roundtrip(calib_dir):
+    calibrate.store_rates("poa", 1, 0.123, 1.77)
+    calibrate._proc_cache.clear()
+    dev, cpu, src = calibrate.get_rates("poa", 1, 0.30, 2.0)
+    assert src == "calibrated"
+    assert dev == pytest.approx(0.123, abs=1e-3)
+    assert cpu == pytest.approx(1.77, abs=1e-2)
+
+
+def test_write_once(calib_dir):
+    calibrate.store_rates("align", 1, 1000.0, 4.0)
+    calibrate.store_rates("align", 1, 5555.0, 9.0)   # ignored
+    calibrate._proc_cache.clear()
+    dev, cpu, src = calibrate.get_rates("align", 1, 1100.0, 4.0)
+    assert dev == pytest.approx(1000.0)
+
+
+def test_recalibrate_env_overwrites(calib_dir, monkeypatch):
+    calibrate.store_rates("align", 1, 1000.0, 4.0)
+    monkeypatch.setenv("RACON_TPU_RECALIBRATE", "1")
+    calibrate.store_rates("align", 1, 2000.0, 5.0)
+    monkeypatch.delenv("RACON_TPU_RECALIBRATE")
+    calibrate._proc_cache.clear()
+    dev, cpu, src = calibrate.get_rates("align", 1, 1100.0, 4.0)
+    assert dev == pytest.approx(2000.0)
+
+
+def test_process_cache_freezes_first_lookup(calib_dir):
+    """Repeated polishes in one process must use identical rates even
+    if a calibration lands mid-process (split determinism)."""
+    dev1, cpu1, src1 = calibrate.get_rates("poa", 1, 0.30, 2.0)
+    calibrate.store_rates("poa", 1, 0.01, 0.02)
+    dev2, cpu2, src2 = calibrate.get_rates("poa", 1, 0.30, 2.0)
+    assert (dev1, cpu1, src1) == (dev2, cpu2, src2)
+
+
+def test_bad_rates_not_stored(calib_dir):
+    calibrate.store_rates("poa", 1, 0.0, -1.0)
+    assert not os.path.exists(calibrate._calib_path()) or \
+        "poa" not in json.load(open(calibrate._calib_path())).get(
+            calibrate._machine_key(1), {})

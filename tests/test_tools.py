@@ -113,6 +113,6 @@ def test_wrapper_split_polish_equals_unsplit(tmp_path):
     assert unsplit.returncode == 0, unsplit.stderr.decode()
     split = run(["--split", "50"] + base)
     assert split.returncode == 0, split.stderr.decode()
-    assert b"total number of splits: 2" in split.stderr
+    assert b"target split into 2 chunk(s)" in split.stderr
     assert split.stdout == unsplit.stdout
     assert unsplit.stdout.count(b">") == 2
