@@ -441,17 +441,19 @@ def prewarm(n: int, lq: int, lt: int, wb: int, mesh=None) -> None:
     traced+compiled when the first rung finishes."""
     from racon_tpu.parallel.mesh_utils import interpret_mode
 
-    interp = interpret_mode()
-    q = jnp.zeros((n, lq), jnp.uint8)
-    t = jnp.zeros((n, lt), jnp.uint8)
-    zl = jnp.zeros((n,), jnp.int32)
     n_dev = len(mesh.devices) if mesh is not None else 1
     if n_dev > 1:
+        interp = interpret_mode()
+        q = jnp.zeros((n, lq), jnp.uint8)
+        t = jnp.zeros((n, lt), jnp.uint8)
+        zl = jnp.zeros((n,), jnp.int32)
         out = _align_sharded(q, t, zl, zl, mesh=mesh, lq=lq, lt=lt,
                              wb=wb, interpret=interp)
+        jax.block_until_ready(out)
     else:
-        out = _align(q, t, zl, zl, lq, lt, wb, interp)
-    jax.block_until_ready(out)
+        # route through align_batch so the AOT-shelf callable the
+        # production dispatch will use is the one warmed here
+        align_batch([b""] * n, [b""] * n, lq, lt, wb, mesh=None)
 
 
 @functools.partial(jax.jit,
@@ -500,7 +502,14 @@ def align_batch(queries, targets, lq: int, lt: int, wb: int,
         tape, meta = _align_sharded(q, t, ql, tl, mesh=mesh, lq=lq,
                                     lt=lt, wb=wb, interpret=interp)
     else:
-        tape, meta = _align(q, t, ql, tl, lq, lt, wb, interp)
+        from racon_tpu.utils import aot_shelf
+
+        def build(qq, tt, qql, ttl):
+            return _align(qq, tt, qql, ttl, lq, lt, wb, interp)
+
+        tape, meta = aot_shelf.call(
+            ("align", n_pad, lq, lt, wb, interp), __file__, build,
+            (q, t, ql, tl))
     tape.copy_to_host_async()
     meta.copy_to_host_async()
     tape = np.asarray(tape)[:n_real].reshape(n_real, -1) \

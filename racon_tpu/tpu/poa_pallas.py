@@ -1029,11 +1029,18 @@ def poa_full_batch(seqs, wts, meta, nlay, bblen, *,
             match=match, mismatch=mismatch, gap=gap, wtype=wtype,
             trim=trim, interpret=interp)
     else:
-        cons, mout = _poa_full(
-            jnp.asarray(seqs), jnp.asarray(wts), jnp.asarray(meta),
-            jnp.asarray(nlay), jnp.asarray(bblen),
-            v, lp, d1, p, s, a, k, wb, match, mismatch, gap, wtype,
-            trim, interp)
+        from racon_tpu.utils import aot_shelf
+
+        statics = (v, lp, d1, p, s, a, k, wb, match, mismatch, gap,
+                   wtype, trim, interp)
+
+        def build(se, wt, me, nl, bb):
+            return _poa_full(se, wt, me, nl, bb, *statics)
+
+        cons, mout = aot_shelf.call(
+            ("poa_full", seqs.shape[0]) + statics, __file__, build,
+            (jnp.asarray(seqs), jnp.asarray(wts), jnp.asarray(meta),
+             jnp.asarray(nlay), jnp.asarray(bblen)))
     # start both device->host copies before blocking on either: the
     # tunnel's per-transfer latency dominates, so pipelining them
     # saves one round trip

@@ -329,10 +329,13 @@ class TPUPolisher(Polisher):
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::polish] skipped "
                 f"{engine.n_skipped_layers} over-long layer(s)")
-        # drop the first device dispatch: it pays the one-time kernel
-        # trace/compile and would overstate the device cost ~2-3x; a
-        # single-dispatch run (the 47 kb sample) simply doesn't
-        # calibrate -- megabase-class runs have many megabatches
+        # drop the first device dispatch and store only when several
+        # remain: the first pays one-time trace/compile/deserialize
+        # costs, and single-dispatch runs (the 47 kb sample) are so
+        # small that fixed dispatch latency swamps the signal --
+        # storing their rates mis-schedules every later run.
+        # Megabase-class runs have many megabatches and calibrate
+        # cleanly.
         dev_w = sum(w for w, _ in meas["dev"][1:])
         dev_u = sum(u for _, u in meas["dev"][1:])
         if dev_u > 0 and meas["cpu_u"] > 0:
@@ -527,10 +530,11 @@ class TPUPolisher(Polisher):
             self._pallas_align([o for _, o in pending[:cut]])
         for f in workers:
             f.result()
-        if cut and meas["cpu_u"] > 0:
-            # drop the first dispatch per band rung (one-time
-            # trace/compile pollutes it); single-chunk runs skip
-            # calibration
+        if cut:
+            # drop the first dispatch per band rung and store only
+            # when later chunks exist: first dispatches pay one-time
+            # trace/compile costs, and single-chunk runs are too small
+            # for fixed dispatch latency not to swamp the signal
             by_rung = {}
             for wb_r, w, rows in self._align_disp:
                 by_rung.setdefault(wb_r, []).append((w, rows))
@@ -539,9 +543,12 @@ class TPUPolisher(Polisher):
             dev_rows = sum(r for ch in by_rung.values()
                            for _, r in ch[1:])
             if dev_rows > 0:
+                # device ns/row transfers across workloads (same
+                # kernel math per row); the CPU d^2 model does not
+                # (WFA cost tracks divergence, which varies by
+                # dataset), so only the device side is calibrated
                 calibrate.store_rates(
-                    "align", n_dev, dev_w * 1e9 * n_dev / dev_rows,
-                    meas["cpu_w"] * 1e9 / meas["cpu_u"])
+                    "align", n_dev, dev_w * 1e9 * n_dev / dev_rows)
         if n_cpu_done:
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::align] cpu-aligned "

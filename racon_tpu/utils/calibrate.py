@@ -33,13 +33,13 @@ _lock = threading.Lock()
 _proc_cache: dict = {}
 
 
-def _calib_path() -> str:
-    base = os.environ.get("RACON_TPU_CACHE_DIR") or os.path.join(
-        os.path.expanduser("~"), ".cache", "racon_tpu", "xla")
-    if not base or base.startswith("~"):
-        base = os.path.join("/tmp", "racon_tpu")
-    return os.path.join(os.path.dirname(base.rstrip("/")) or base,
-                        "calibration.json")
+def _calib_path():
+    from racon_tpu.utils.xla_cache import cache_root
+
+    root = cache_root()
+    if root is None:
+        return None
+    return os.path.join(root, "calibration.json")
 
 
 def _machine_key(n_dev: int) -> str:
@@ -67,13 +67,15 @@ def get_rates(stage: str, n_dev: int, default_dev: float,
             out = (float(env_dev), float(env_cpu), "env")
         else:
             out = (default_dev, default_cpu, "default")
-            if not os.environ.get("RACON_TPU_RECALIBRATE"):
+            if not os.environ.get("RACON_TPU_RECALIBRATE") \
+                    and _calib_path():
                 try:
                     with open(_calib_path()) as f:
                         data = json.load(f)
                     ent = data.get(_machine_key(n_dev), {}).get(stage)
                     if ent:
-                        out = (float(ent["dev"]), float(ent["cpu"]),
+                        out = (float(ent.get("dev", default_dev)),
+                               float(ent.get("cpu", default_cpu)),
                                "calibrated")
                 except Exception:
                     pass
@@ -82,13 +84,20 @@ def get_rates(stage: str, n_dev: int, default_dev: float,
 
 
 def store_rates(stage: str, n_dev: int, dev_rate: float,
-                cpu_rate: float) -> None:
+                cpu_rate=None) -> None:
     """Persist measured rates (write-once per machine key + stage;
-    RACON_TPU_RECALIBRATE=1 overwrites).  Never raises."""
-    if not (dev_rate > 0 and cpu_rate > 0):
+    RACON_TPU_RECALIBRATE=1 overwrites).  ``cpu_rate=None`` stores the
+    device rate only -- used by stages whose CPU cost model does not
+    transfer across workloads (the aligner's d^2 model fitted on one
+    dataset's tail misprices another's divergence), so the measured
+    device rate combines with the conservative CPU default.  Never
+    raises."""
+    if not dev_rate > 0 or (cpu_rate is not None and not cpu_rate > 0):
         return
     try:
         path = _calib_path()
+        if path is None:
+            return
         mkey = _machine_key(n_dev)
         with _lock:
             data = {}
@@ -101,8 +110,9 @@ def store_rates(stage: str, n_dev: int, dev_rate: float,
             if stage in ent and \
                     not os.environ.get("RACON_TPU_RECALIBRATE"):
                 return
-            ent[stage] = {"dev": round(dev_rate, 4),
-                          "cpu": round(cpu_rate, 4)}
+            ent[stage] = {"dev": round(dev_rate, 4)}
+            if cpu_rate is not None:
+                ent[stage]["cpu"] = round(cpu_rate, 4)
             os.makedirs(os.path.dirname(path), exist_ok=True)
             tmp = path + f".tmp{os.getpid()}"
             with open(tmp, "w") as f:

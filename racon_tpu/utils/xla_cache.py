@@ -18,6 +18,23 @@ import os
 _enabled = False
 
 
+def cache_root():
+    """The racon_tpu cache ROOT directory (holding the xla/, aot/
+    subdirs and calibration.json), honoring RACON_TPU_CACHE_DIR: unset
+    -> ~/.cache/racon_tpu, empty (or unexpanded '~' when HOME is
+    unset) -> None = caching disabled.  A custom value names the XLA
+    subdir; its parent is the root (matching enable_compilation_cache
+    below)."""
+    path = os.environ.get(
+        "RACON_TPU_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "racon_tpu",
+                     "xla"))
+    if not path or path.startswith("~"):
+        return None
+    root = os.path.dirname(path.rstrip("/"))
+    return root or None
+
+
 def enable_compilation_cache() -> None:
     global _enabled
     if _enabled:

@@ -1,0 +1,85 @@
+"""AOT shelf (racon_tpu/utils/aot_shelf.py): export round-trip,
+corrupt-artifact recovery, disable semantics.  jax.export works on the
+CPU backend, so the full path is exercised by monkeypatching the
+TPU-only gate.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from racon_tpu.utils import aot_shelf
+
+
+@pytest.fixture()
+def shelf(tmp_path, monkeypatch):
+    monkeypatch.setenv("RACON_TPU_CACHE_DIR", str(tmp_path / "xla"))
+    monkeypatch.setattr(aot_shelf, "enabled", lambda: True)
+    aot_shelf._mem.clear()
+    aot_shelf._salts.clear()
+    yield tmp_path / "aot"
+    aot_shelf._mem.clear()
+    aot_shelf._salts.clear()
+
+
+def _build(x, y):
+    import jax.numpy as jnp
+    return jnp.dot(x, y) + 1.0
+
+
+X = np.ones((8, 8), np.float32)
+Y = np.eye(8, dtype=np.float32)
+
+
+def test_roundtrip_and_artifact(shelf):
+    out1 = aot_shelf.call(("t", 8), __file__, _build, (X, Y))
+    files = list(shelf.glob("*.jexp"))
+    assert len(files) == 1, "export artifact not written"
+    # fresh process simulation: clear memory, hit the disk artifact
+    aot_shelf._mem.clear()
+    out2 = aot_shelf.call(("t", 8), __file__, _build, (X, Y))
+    assert np.array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_corrupt_artifact_recovers(shelf):
+    aot_shelf.call(("t", 8), __file__, _build, (X, Y))
+    (path,) = shelf.glob("*.jexp")
+    path.write_bytes(b"garbage")
+    aot_shelf._mem.clear()
+    out = aot_shelf.call(("t", 8), __file__, _build, (X, Y))
+    assert np.array_equal(np.asarray(out), np.asarray(_build(X, Y)))
+    # the corrupt file was replaced by a fresh export
+    (path2,) = shelf.glob("*.jexp")
+    assert path2.read_bytes() != b"garbage"
+
+
+def test_key_varies_with_parts(shelf):
+    aot_shelf.call(("a",), __file__, _build, (X, Y))
+    aot_shelf.call(("b",), __file__, _build, (X, Y))
+    assert len(list(shelf.glob("*.jexp"))) == 2
+
+
+def test_disabled_cache_dir_bypasses(shelf, monkeypatch):
+    monkeypatch.setenv("RACON_TPU_CACHE_DIR", "")
+    out = aot_shelf.call(("t", 8), __file__, _build, (X, Y))
+    assert np.array_equal(np.asarray(out), np.asarray(_build(X, Y)))
+    assert not shelf.exists()
+
+
+def test_unexportable_memoizes_plain_path(shelf):
+    """A function jax.export cannot handle falls back to (and
+    memoizes) the plain path instead of retrying exports forever."""
+    calls = []
+
+    def host_side(x, y):
+        # np.asarray on a tracer fails under jit/export; the plain
+        # call works on concrete arrays
+        calls.append(1)
+        return np.asarray(x) @ np.asarray(y)
+
+    out = aot_shelf.call(("host",), __file__, host_side, (X, Y))
+    assert np.array_equal(out, X @ Y)
+    assert not list(shelf.glob("*.jexp"))
+    aot_shelf.call(("host",), __file__, host_side, (X, Y))
+    assert len(calls) >= 2      # served by the memoized plain path

@@ -78,3 +78,10 @@ def test_bad_rates_not_stored(calib_dir):
     assert not os.path.exists(calibrate._calib_path()) or \
         "poa" not in json.load(open(calibrate._calib_path())).get(
             calibrate._machine_key(1), {})
+
+
+def test_dev_only_store_keeps_cpu_default(calib_dir):
+    calibrate.store_rates("align", 1, 800.0)
+    calibrate._proc_cache.clear()
+    dev, cpu, src = calibrate.get_rates("align", 1, 1100.0, 4.0)
+    assert (dev, cpu, src) == (pytest.approx(800.0), 4.0, "calibrated")
