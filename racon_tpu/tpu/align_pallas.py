@@ -103,11 +103,22 @@ def _kernel(ql_ref, tl_ref, q_ref, t_ref, tape_ref, dist_ref,
     nqs = [jnp.maximum(x, 1) for x in qls]
     smaxs = [(jnp.maximum(tls[s] + 1 - wb, 0) + q - 1) // q
              for s in range(_S)]
+    # q8 fixed-point diagonal slopes, one divide per pair per PROGRAM:
+    # the row loop calls sqq twice per pair per row, and a dynamic
+    # integer divide on the scalar core is many-cycle.  The clamp
+    # bounds i*slope inside int32 (i <= 2^14, slope < 2^17).  Worst-
+    # case rounding deficit vs the exact divide is i/256 <= 64 columns
+    # (half a quantum, so the band start may sit one 128-column
+    # quantum lower); the Ukkonen certificate budget in the dispatcher
+    # keeps >= wb/2 - 256 columns of margin per side, which still
+    # covers it with a quantum to spare.
+    slopes = [jnp.minimum((tls[s] * 256) // nqs[s], (1 << 17) - 1)
+              for s in range(_S)]
 
     def sqq(s, i):
         """Quantized band start for pair s, row i: centered on the
         proportional diagonal (symmetric margins >= wb/2 - 128)."""
-        return jnp.clip(((i * tls[s]) // nqs[s] - (wb // 2)) // q,
+        return jnp.clip((((i * slopes[s]) >> 8) - (wb // 2)) >> 7,
                         0, smaxs[s])
 
     def stackv(vals, dtype=jnp.int32):
