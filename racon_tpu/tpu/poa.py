@@ -420,48 +420,77 @@ class TPUPoaBatchEngine:
 
     def consensus_batch(self, windows, trim: bool, pool=None) \
             -> List[Tuple[Optional[bytes], bool]]:
-        """Polish a batch of Window objects on device.
+        """Polish a batch of Window objects on device (blocking).
 
         Returns one (consensus, polished) pair per window; consensus is
         None when the window overflowed the device caps and must be
         re-polished on the CPU (reference: cudapolisher.cpp:357-386).
+        """
+        return self.consensus_batch_async(windows, trim, pool)()
+
+    def consensus_batch_async(self, windows, trim: bool, pool=None):
+        """Dispatch a batch and return a zero-arg collect closure.
 
         On a TPU backend (or with Pallas interpret mode forced) the
         whole POA runs inside ONE Pallas dispatch
         (racon_tpu/tpu/poa_pallas.py, the cudapoa-shaped design),
         sharded over the mesh batch axis when the mesh has more than
-        one device; otherwise the portable lockstep lax.scan engine
-        below is used.
+        one device, and the dispatch returns BEFORE the device
+        finishes -- callers can pack/dispatch the next megabatch while
+        this one computes (upload + host packing overlap device time).
+        Otherwise the portable lockstep lax.scan engine runs
+        synchronously and the closure just returns its results.
         """
         from racon_tpu.tpu import poa_pallas
-        if poa_pallas.available():
+        if self.will_dispatch_async(windows):
             # the kernel's window type is a compile-time constant;
             # split mixed batches so each window trims per its own
             # type (parity with the per-window lockstep/CPU paths).
             # _fits_full_device rejects configurations that exceed the
             # kernel's VMEM budget -> lockstep below.
             types = {w.type.value for w in windows}
-            if self._fits_full_device(windows):
+            if True:
                 if len(types) <= 1:
-                    return self._run_full_device(windows, trim)
-                results: List[Tuple[Optional[bytes], bool]] = \
-                    [None] * len(windows)
+                    return self._run_full_device_async(windows, trim)
+                collects = []
                 for tv in sorted(types):
                     idxs = [i for i, w in enumerate(windows)
                             if w.type.value == tv]
-                    sub = self._run_full_device(
-                        [windows[i] for i in idxs], trim)
-                    for i, r in zip(idxs, sub):
-                        results[i] = r
-                return results
+                    collects.append(
+                        (idxs, self._run_full_device_async(
+                            [windows[i] for i in idxs], trim)))
+
+                def collect_mixed():
+                    results: List[Tuple[Optional[bytes], bool]] = \
+                        [None] * len(windows)
+                    for idxs, coll in collects:
+                        for i, r in zip(idxs, coll()):
+                            results[i] = r
+                    return results
+
+                return collect_mixed
         n = len(windows)
-        nb = _NativeBatch(n)
-        try:
-            return self._run(nb, windows, trim, pool)
-        finally:
-            nb.close()
+
+        def run_lockstep():
+            nb = _NativeBatch(n)
+            try:
+                return self._run(nb, windows, trim, pool)
+            finally:
+                nb.close()
+
+        out = run_lockstep()
+        return lambda: out
 
     # -- full on-device path (flagship Pallas kernel) ------------------
+
+    def will_dispatch_async(self, windows) -> bool:
+        """True when ``consensus_batch_async`` would return before the
+        device finishes (the full-device Pallas path); the lockstep
+        fallback runs synchronously at dispatch time, so pipelining
+        callers must not attribute its wall to an in-flight batch."""
+        from racon_tpu.tpu import poa_pallas
+        return poa_pallas.available() and \
+            self._fits_full_device(windows)
 
     def _fits_full_device(self, windows) -> bool:
         """Side-effect-free VMEM precheck (d1 from raw layer counts,
@@ -485,9 +514,9 @@ class TPUPoaBatchEngine:
         self.n_skipped_layers += len(idx) - len(kept)
         return kept
 
-    def _run_full_device(self, windows, trim) \
-            -> List[Tuple[Optional[bytes], bool]]:
-        """Callers must have passed _fits_full_device first."""
+    def _run_full_device_async(self, windows, trim):
+        """Dispatch one megabatch; returns a zero-arg collect closure.
+        Callers must have passed _fits_full_device first."""
         from racon_tpu.tpu import poa_pallas
         from racon_tpu.utils.tuning import pow2_at_least
 
@@ -503,12 +532,17 @@ class TPUPoaBatchEngine:
                     out[i] = (w.sequences[0], False)
                 else:
                     dev_idx.append(i)
-            if dev_idx:
-                sub = self._run_full_device(
-                    [windows[i] for i in dev_idx], trim)
-                for i, r in zip(dev_idx, sub):
-                    out[i] = r
-            return out
+            sub = self._run_full_device_async(
+                [windows[i] for i in dev_idx], trim) if dev_idx \
+                else None
+
+            def collect_shortcut():
+                if sub is not None:
+                    for i, r in zip(dev_idx, sub()):
+                        out[i] = r
+                return out
+
+            return collect_shortcut
 
         n = len(windows)
         layer_lists = [self._order_layers(w) for w in windows]
@@ -556,45 +590,59 @@ class TPUPoaBatchEngine:
                 meta[b, d, :4] = (begin, end, full, len(s))
         self.phase_walls["export"] += time.monotonic() - t0
 
-        t0 = time.monotonic()
-        cons, mout = poa_pallas.poa_full_batch(
+        t_disp = time.monotonic()
+        handle = poa_pallas.poa_full_dispatch(
             seqs, wts, meta, nlay, bblen, v=v, lp=lp, d1=d1,
             p=self.pcap, s=self.pcap, a=8, k=self.kcap, wb=wb,
             match=self.match, mismatch=self.mismatch, gap=self.gap,
             wtype=windows[0].type.value, trim=1 if trim else 0,
             mesh=self.mesh)
-        dt = time.monotonic() - t0
-        self.phase_walls["dispatch"] += dt
-        if os.environ.get("RACON_TPU_POA_TRACE"):
-            import sys
-            live = nlay[:n][nlay[:n] > 0]
-            lo = int(live.min()) if live.size else 0
-            print(f"[poa-trace] b={n}(pad {b_pad}) d1={d1} "
-                  f"depths {lo}..{int(nlay[:n].max())} "
-                  f"wall {dt:.2f}s", file=sys.stderr, flush=True)
-        self.n_rounds += 1
-        self.cells += int(mout[:n, 4].sum()) * wb
 
-        t0 = time.monotonic()
-        results: List[Tuple[Optional[bytes], bool]] = []
-        code_map = {poa_pallas.FAIL_VCAP: -1, poa_pallas.FAIL_EDGE: -2,
-                    poa_pallas.FAIL_ALIGNED: -2,
-                    poa_pallas.FAIL_KCAP: -3, poa_pallas.FAIL_PATH: -3}
-        for b, w in enumerate(windows):
-            length = int(mout[b, 0])
-            if host_fail[b] or length < 0:
-                code = code_map.get(int(mout[b, 2]), -1)
-                with self._reject_lock:
-                    self.reject_counts[code] = \
-                        self.reject_counts.get(code, 0) + 1
-                results.append((None, False))
-                continue
-            if int(mout[b, 1]) == 2:
-                w.warn_chimeric()
-            results.append(
-                (bytes(cons[b, :length].astype(np.uint8)), True))
-        self.phase_walls["extract"] += time.monotonic() - t0
-        return results
+        def collect():
+            t0 = time.monotonic()
+            cons, mout = handle()
+            blocked = time.monotonic() - t0
+            # NOTE under the two-deep pipeline: "dispatch" counts only
+            # the UN-overlapped blocking residual (device time hidden
+            # behind the next batch's packing shows up in no bucket),
+            # so phase walls no longer sum to the stage wall
+            self.phase_walls["dispatch"] += blocked
+            if os.environ.get("RACON_TPU_POA_TRACE"):
+                import sys
+                live = nlay[:n][nlay[:n] > 0]
+                lo = int(live.min()) if live.size else 0
+                print(f"[poa-trace] b={n}(pad {b_pad}) d1={d1} "
+                      f"depths {lo}..{int(nlay[:n].max())} "
+                      f"span {time.monotonic() - t_disp:.2f}s "
+                      f"blocked {blocked:.2f}s",
+                      file=sys.stderr, flush=True)
+            self.n_rounds += 1
+            self.cells += int(mout[:n, 4].sum()) * wb
+
+            t1 = time.monotonic()
+            results: List[Tuple[Optional[bytes], bool]] = []
+            code_map = {poa_pallas.FAIL_VCAP: -1,
+                        poa_pallas.FAIL_EDGE: -2,
+                        poa_pallas.FAIL_ALIGNED: -2,
+                        poa_pallas.FAIL_KCAP: -3,
+                        poa_pallas.FAIL_PATH: -3}
+            for b, w in enumerate(windows):
+                length = int(mout[b, 0])
+                if host_fail[b] or length < 0:
+                    code = code_map.get(int(mout[b, 2]), -1)
+                    with self._reject_lock:
+                        self.reject_counts[code] = \
+                            self.reject_counts.get(code, 0) + 1
+                    results.append((None, False))
+                    continue
+                if int(mout[b, 1]) == 2:
+                    w.warn_chimeric()
+                results.append(
+                    (bytes(cons[b, :length].astype(np.uint8)), True))
+            self.phase_walls["extract"] += time.monotonic() - t1
+            return results
+
+        return collect
 
     # -- helpers -------------------------------------------------------
 

@@ -994,14 +994,25 @@ def _poa_full_sharded(seqs, wts, meta, nlay, bblen, *, mesh,
         seqs, wts, meta, nlay, bblen)
 
 
-def poa_full_batch(seqs, wts, meta, nlay, bblen, *,
-                   v, lp, d1, p=16, s=16, a=8, k=128, wb=256,
-                   match=5, mismatch=-4, gap=-8, wtype=1, trim=1,
-                   mesh=None):
-    """NumPy-facing wrapper.  Returns (cons_chars [B, V] int32 np,
-    mout [B, 8] int32 np).  mout rows: 0 length (-1 = failed ->
-    CPU re-polish), 1 status (2 = chimeric warning), 2 fail code,
-    3 nodes used, 4 total DP rank steps (for cells accounting).
+def poa_full_batch(seqs, wts, meta, nlay, bblen, **kw):
+    """NumPy-facing wrapper: dispatch + blocking collect.  Returns
+    (cons_chars [B, V] int32 np, mout [B, 8] int32 np).  mout rows:
+    0 length (-1 = failed -> CPU re-polish), 1 status (2 = chimeric
+    warning), 2 fail code, 3 nodes used, 4 total DP rank steps (for
+    cells accounting)."""
+    return poa_full_dispatch(seqs, wts, meta, nlay, bblen, **kw)()
+
+
+def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
+                      v, lp, d1, p=16, s=16, a=8, k=128, wb=256,
+                      match=5, mismatch=-4, gap=-8, wtype=1, trim=1,
+                      mesh=None):
+    """Enqueue one megabatch and return a zero-arg ``collect``
+    closure.  The upload and kernel run asynchronously after dispatch,
+    so a caller can pack (and dispatch) the NEXT megabatch while this
+    one computes -- the tunnel's upload latency and the host packing
+    then overlap device time (the cudapolisher analog runs per-device
+    batch queues on threads, src/cuda/cudapolisher.cpp:257-336).
 
     With a multi-device ``mesh`` the batch axis is sharded across the
     devices (callers pad the batch; this pads further to a mesh
@@ -1046,5 +1057,9 @@ def poa_full_batch(seqs, wts, meta, nlay, bblen, *,
     # saves one round trip
     cons.copy_to_host_async()
     mout.copy_to_host_async()
-    # slice off any mesh-multiple pad rows: the contract is [B, ...]
-    return np.asarray(cons)[:b0, :, 0], np.asarray(mout)[:b0, :, 0]
+
+    def collect():
+        # slice off mesh-multiple pad rows: the contract is [B, ...]
+        return np.asarray(cons)[:b0, :, 0], np.asarray(mout)[:b0, :, 0]
+
+    return collect

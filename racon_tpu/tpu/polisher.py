@@ -281,6 +281,33 @@ class TPUPolisher(Polisher):
                    for _ in range(n_workers)]
 
         failed: List[int] = []
+        # two-deep pipeline: dispatch megabatch k+1 (upload + kernel
+        # enqueue are async) BEFORE collecting k, so host packing and
+        # the tunnel's upload latency overlap device compute -- the
+        # async analog of the reference's threaded per-device batch
+        # queues (src/cuda/cudapolisher.cpp:257-336).  Results apply
+        # in FIFO order, so output stays deterministic.
+        pending = None          # (idxs, collect_fn)
+        mark = _time.monotonic()
+
+        def apply(idxs, collect, record=True):
+            nonlocal mark
+            results = collect()
+            now = _time.monotonic()
+            if record:
+                meas["dev"].append((now - mark,
+                                    sum(unit_of[i] for i in idxs)))
+            mark = now
+            for i, (cons, ok) in zip(idxs, results):
+                if cons is None:
+                    failed.append(i)
+                else:
+                    self.windows[i].consensus = cons
+                    flags[i] = ok
+                    self.poa_device_windows += 1
+            self.logger.bar("[racon_tpu::TPUPolisher::polish] "
+                            "generating consensus (device)")
+
         while True:
             with lock:
                 limit = len(work) if steal else min(len(work),
@@ -293,20 +320,26 @@ class TPUPolisher(Polisher):
             if not idxs:
                 break
             batch = [self.windows[i] for i in idxs]
-            t1 = _time.monotonic()
-            results = engine.consensus_batch(batch, self.trim,
-                                             pool=self._pool)
-            meas["dev"].append((_time.monotonic() - t1,
-                                sum(unit_of[i] for i in idxs)))
-            for i, (cons, ok) in zip(idxs, results):
-                if cons is None:
-                    failed.append(i)
-                else:
-                    self.windows[i].consensus = cons
-                    flags[i] = ok
-                    self.poa_device_windows += 1
-            self.logger.bar("[racon_tpu::TPUPolisher::polish] generating"
-                            " consensus (device)")
+            if not engine.will_dispatch_async(batch):
+                # the lockstep fallback runs synchronously at dispatch
+                # time: drain the pipeline first so the in-flight
+                # batch's measured interval stays honest, and skip
+                # recording the lockstep batch (its engine rate is not
+                # the full-device rate the calibration models)
+                if pending is not None:
+                    apply(*pending)
+                    pending = None
+                collect = engine.consensus_batch_async(
+                    batch, self.trim, pool=self._pool)
+                apply(idxs, collect, record=False)
+                continue
+            collect = engine.consensus_batch_async(batch, self.trim,
+                                                   pool=self._pool)
+            if pending is not None:
+                apply(*pending)
+            pending = (idxs, collect)
+        if pending is not None:
+            apply(*pending)
         for fut in workers:
             fut.result()
 
