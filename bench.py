@@ -71,6 +71,29 @@ def accuracy(polished):
     return cpu.edit_distance(rc, ref_seq)
 
 
+_T_START = time.monotonic()
+
+
+def _budget_left(need_s: float, label: str) -> bool:
+    """True when the optional leg fits the bench's wall budget.  The
+    driver runs bench.py with an unknown external timeout; losing the
+    final JSON line to a kill mid-leg would lose the whole record, so
+    expensive legs self-skip when the remaining budget
+    (RACON_TPU_BENCH_BUDGET_S, default 1500 s) cannot cover them."""
+    try:
+        budget = float(os.environ.get("RACON_TPU_BENCH_BUDGET_S",
+                                      "1500"))
+    except ValueError:
+        log("[bench] bad RACON_TPU_BENCH_BUDGET_S, using 1500")
+        budget = 1500.0
+    left = budget - (time.monotonic() - _T_START)
+    if left < need_s:
+        log(f"[bench] skipping {label}: {left:.0f}s of budget left, "
+            f"needs ~{need_s:.0f}s")
+        return False
+    return True
+
+
 def main():
     if not os.path.isdir(DATA):
         print(json.dumps({"metric": "sample_e2e_polish_wall_s",
@@ -200,6 +223,8 @@ def scale_bench():
     utilization).  Disable with RACON_TPU_BENCH_SCALE=0."""
     if os.environ.get("RACON_TPU_BENCH_SCALE", "1") == "0":
         return {}
+    if not _budget_left(120, "scale legs"):
+        return {}
     import tempfile
 
     from racon_tpu.core.polisher import PolisherType, create_polisher
@@ -259,6 +284,8 @@ def mega_bench():
     if os.environ.get("RACON_TPU_BENCH_MEGA",
                       "1" if on_tpu else "0") != "1":
         return {}
+    if not _budget_left(420, "mega TPU leg"):
+        return {}
     import tempfile
 
     from racon_tpu.core.polisher import PolisherType, create_polisher
@@ -296,7 +323,8 @@ def mega_bench():
             "mega_device_window_share": round(
                 dev_windows / max(total_windows, 1), 3),
         }
-        if os.environ.get("RACON_TPU_BENCH_MEGA_CPU", "1") == "1":
+        if os.environ.get("RACON_TPU_BENCH_MEGA_CPU", "1") == "1" \
+                and _budget_left(700, "mega CPU reference leg"):
             cpu_wall, cpu_out, _ = run(0, 0)
             d_cpu = cpu.edit_distance(cpu_out[0].data, truth)
             out.update({
