@@ -79,13 +79,14 @@ def _budget_left(need_s: float, label: str) -> bool:
     driver runs bench.py with an unknown external timeout; losing the
     final JSON line to a kill mid-leg would lose the whole record, so
     expensive legs self-skip when the remaining budget
-    (RACON_TPU_BENCH_BUDGET_S, default 1500 s) cannot cover them."""
+    (RACON_TPU_BENCH_BUDGET_S, default 1700 s) cannot cover them.
+    Leg estimates are measured r4 walls plus ~10% jitter headroom."""
     try:
         budget = float(os.environ.get("RACON_TPU_BENCH_BUDGET_S",
-                                      "1500"))
+                                      "1700"))
     except ValueError:
-        log("[bench] bad RACON_TPU_BENCH_BUDGET_S, using 1500")
-        budget = 1500.0
+        log("[bench] bad RACON_TPU_BENCH_BUDGET_S, using 1700")
+        budget = 1700.0
     left = budget - (time.monotonic() - _T_START)
     if left < need_s:
         log(f"[bench] skipping {label}: {left:.0f}s of budget left, "
@@ -284,7 +285,7 @@ def mega_bench():
     if os.environ.get("RACON_TPU_BENCH_MEGA",
                       "1" if on_tpu else "0") != "1":
         return {}
-    if not _budget_left(420, "mega TPU leg"):
+    if not _budget_left(380, "mega TPU leg"):
         return {}
     import tempfile
 
@@ -324,7 +325,7 @@ def mega_bench():
                 dev_windows / max(total_windows, 1), 3),
         }
         if os.environ.get("RACON_TPU_BENCH_MEGA_CPU", "1") == "1" \
-                and _budget_left(700, "mega CPU reference leg"):
+                and _budget_left(660, "mega CPU reference leg"):
             cpu_wall, cpu_out, _ = run(0, 0)
             d_cpu = cpu.edit_distance(cpu_out[0].data, truth)
             out.update({
