@@ -10,3 +10,10 @@ if [ "${DEBUG:-0}" = "1" ]; then
 else
     make -C racon_tpu/native -j
 fi
+# build-time kernel compilation (the reference precompiles its CUDA
+# kernels at build time): trace+shelve the manifest's kernel variants
+# so no later run pays first-contact compiles.  No-op off-TPU; never
+# fails the build (PREBUILD=0 skips).
+if [ "${PREBUILD:-1}" = "1" ]; then
+    python -m racon_tpu.prebuild || true
+fi

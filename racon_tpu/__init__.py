@@ -14,6 +14,48 @@ v1.4.15 + CUDA offload) with a TPU-first architecture:
   (reference: src/cuda/cudapolisher.cpp:357-386).
 """
 
-__version__ = "0.1.0"
-
 from racon_tpu.core.polisher import PolisherType, create_polisher  # noqa: F401
+
+_BASE_VERSION = "0.1.0"
+
+
+_version_cache = None
+
+
+def _git_version() -> str:
+    """Stamp the version from git metadata when running from a
+    checkout, like the reference's generated version header
+    (reference: meson.build:50-75 runs ``git describe`` at build
+    time); installed copies fall back to the static version.  The
+    checkout must be THIS package's repo (its toplevel holding the
+    package dir), not whatever unrelated repo happens to enclose an
+    installed site-packages."""
+    global _version_cache
+    if _version_cache is not None:
+        return _version_cache
+    import os
+    import subprocess
+    _version_cache = _BASE_VERSION
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], cwd=pkg_dir,
+            capture_output=True, text=True, timeout=5)
+        if top.returncode != 0 or \
+                top.stdout.strip() != os.path.dirname(pkg_dir):
+            return _version_cache
+        out = subprocess.run(
+            ["git", "describe", "--tags", "--always", "--dirty"],
+            cwd=pkg_dir, capture_output=True, text=True, timeout=5)
+        desc = out.stdout.strip()
+        if out.returncode == 0 and desc:
+            _version_cache = f"{_BASE_VERSION}+git.{desc}"
+    except Exception:
+        pass
+    return _version_cache
+
+
+def __getattr__(name):  # PEP 562: lazy, so imports stay subprocess-free
+    if name == "__version__":
+        return _git_version()
+    raise AttributeError(name)

@@ -449,26 +449,25 @@ class TPUPoaBatchEngine:
             # _fits_full_device rejects configurations that exceed the
             # kernel's VMEM budget -> lockstep below.
             types = {w.type.value for w in windows}
-            if True:
-                if len(types) <= 1:
-                    return self._run_full_device_async(windows, trim)
-                collects = []
-                for tv in sorted(types):
-                    idxs = [i for i, w in enumerate(windows)
-                            if w.type.value == tv]
-                    collects.append(
-                        (idxs, self._run_full_device_async(
-                            [windows[i] for i in idxs], trim)))
+            if len(types) <= 1:
+                return self._run_full_device_async(windows, trim)
+            collects = []
+            for tv in sorted(types):
+                idxs = [i for i, w in enumerate(windows)
+                        if w.type.value == tv]
+                collects.append(
+                    (idxs, self._run_full_device_async(
+                        [windows[i] for i in idxs], trim)))
 
-                def collect_mixed():
-                    results: List[Tuple[Optional[bytes], bool]] = \
-                        [None] * len(windows)
-                    for idxs, coll in collects:
-                        for i, r in zip(idxs, coll()):
-                            results[i] = r
-                    return results
+            def collect_mixed():
+                results: List[Tuple[Optional[bytes], bool]] = \
+                    [None] * len(windows)
+                for idxs, coll in collects:
+                    for i, r in zip(idxs, coll()):
+                        results[i] = r
+                return results
 
-                return collect_mixed
+            return collect_mixed
         n = len(windows)
 
         def run_lockstep():

@@ -84,8 +84,19 @@ def available() -> bool:
 
 def band_width(lp: int, band_cols: int = 0) -> int:
     """The on-device DP band width for layer cap ``lp`` (same clamp
-    the engine and the shape-prediction prewarm must agree on)."""
-    wb = max(256, ((band_cols or lp // 4) + 127) & ~127)
+    the engine and the shape-prediction prewarm must agree on).
+
+    An explicit ``band_cols`` (the CLI's -b, engine default 128) is
+    honored down to one 128-lane quantum -- the cudapoa banded-kernel
+    analog (reference: src/cuda/cudabatch.cpp:54-62 selects a
+    genuinely narrower kernel under -b); alignments that fall out of
+    the narrow band fail to the CPU engine per the reject contract.
+    The auto band (band_cols 0) keeps the quarter-of-cap, floor-256
+    shape."""
+    if band_cols:
+        wb = max(128, (band_cols + 127) & ~127)
+    else:
+        wb = max(256, (lp // 4 + 127) & ~127)
     return min(wb, ((lp + 127) & ~127))
 
 

@@ -23,7 +23,14 @@ import threading
 
 _mem: dict = {}
 _salts: dict = {}
+_recorded: set = set()
 _lock = threading.Lock()
+
+# bump when kernel-relevant code OUTSIDE the keyed source file changes
+# behavior (the key hashes only the caller's own source file; helpers
+# that migrate into imported modules would otherwise replay stale
+# exports)
+_SHELF_VERSION = 1
 
 
 def _shelf_dir():
@@ -63,6 +70,44 @@ def enabled() -> bool:
         return False
 
 
+def _record_manifest(key_parts: tuple) -> None:
+    """Append a variant's key_parts to the shelf manifest (dedup).
+
+    The manifest is what ``python -m racon_tpu.prebuild`` replays to
+    build every previously-seen kernel variant at install time -- the
+    analog of the reference's build-time CUDA kernel compilation
+    (SURVEY.md §2.3 L4g): after a code change or on a fresh cache,
+    one untimed prebuild pass re-traces everything instead of the
+    first polish paying each variant serially."""
+    with _lock:
+        if key_parts in _recorded:   # hot path: one set probe per call
+            return
+        _recorded.add(key_parts)
+    d = _shelf_dir()
+    if d is None:
+        return
+    import json
+    path = os.path.join(d, "manifest.json")
+    with _lock:
+        try:
+            with open(path) as f:
+                entries = json.load(f)
+        except (OSError, ValueError):
+            entries = []
+        entry = list(key_parts)
+        if entry in entries:
+            return
+        entries.append(entry)
+        try:
+            os.makedirs(d, exist_ok=True)
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(entries, f, indent=0)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # the manifest is an optimization, never a failure
+
+
 def call(key_parts: tuple, src_file: str, build_fn, args: tuple):
     """Invoke ``build_fn(*args)`` through a shelved export when
     possible.  ``build_fn`` must be a pure jit-able function of
@@ -70,11 +115,13 @@ def call(key_parts: tuple, src_file: str, build_fn, args: tuple):
     in ``key_parts``)."""
     if not enabled() or _shelf_dir() is None:
         return build_fn(*args)
+    _record_manifest(key_parts)
     import jax
     from jax import export as jexport
 
     key = hashlib.sha1(
-        repr((key_parts, _source_salt(src_file), jax.__version__,
+        repr((key_parts, _source_salt(src_file), _SHELF_VERSION,
+              jax.__version__,
               jax.devices()[0].platform)).encode()).hexdigest()[:24]
     with _lock:
         fn = _mem.get(key)
@@ -119,6 +166,11 @@ def call(key_parts: tuple, src_file: str, build_fn, args: tuple):
     try:
         fn = jax.jit(exp.call)
         out = fn(*args)
+        # surface async device-side failures of a stale artifact NOW,
+        # while the fallback below can still retrace (JAX dispatch is
+        # async; without this the error fires later at collect(),
+        # outside any try) -- one-time cost on first use only
+        jax.block_until_ready(out)
     except Exception:
         try:
             os.remove(path)

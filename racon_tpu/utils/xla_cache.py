@@ -22,17 +22,14 @@ def cache_root():
     """The racon_tpu cache ROOT directory (holding the xla/, aot/
     subdirs and calibration.json), honoring RACON_TPU_CACHE_DIR: unset
     -> ~/.cache/racon_tpu, empty (or unexpanded '~' when HOME is
-    unset) -> None = caching disabled.  A custom value names the XLA
-    subdir; its parent is the root (matching enable_compilation_cache
-    below)."""
+    unset) -> None = caching disabled.  A custom value names the root
+    itself; the XLA cache lives in its xla/ subdirectory."""
     path = os.environ.get(
         "RACON_TPU_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "racon_tpu",
-                     "xla"))
+        os.path.join(os.path.expanduser("~"), ".cache", "racon_tpu"))
     if not path or path.startswith("~"):
         return None
-    root = os.path.dirname(path.rstrip("/"))
-    return root or None
+    return path.rstrip("/") or None
 
 
 def enable_compilation_cache() -> None:
@@ -40,12 +37,10 @@ def enable_compilation_cache() -> None:
     if _enabled:
         return
     _enabled = True
-    path = os.environ.get(
-        "RACON_TPU_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "racon_tpu",
-                     "xla"))
-    if not path or path.startswith("~"):  # HOME unset -> literal "~"
+    root = cache_root()
+    if root is None:  # HOME unset -> literal "~", or explicit empty
         return
+    path = os.path.join(root, "xla")
     import jax
 
     try:

@@ -14,11 +14,12 @@ from racon_tpu.utils import aot_shelf
 
 @pytest.fixture()
 def shelf(tmp_path, monkeypatch):
-    monkeypatch.setenv("RACON_TPU_CACHE_DIR", str(tmp_path / "xla"))
+    monkeypatch.setenv("RACON_TPU_CACHE_DIR", str(tmp_path / "cache"))
     monkeypatch.setattr(aot_shelf, "enabled", lambda: True)
     aot_shelf._mem.clear()
     aot_shelf._salts.clear()
-    yield tmp_path / "aot"
+    # RACON_TPU_CACHE_DIR names the cache ROOT; the shelf is its aot/
+    yield tmp_path / "cache" / "aot"
     aot_shelf._mem.clear()
     aot_shelf._salts.clear()
 

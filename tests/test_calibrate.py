@@ -14,7 +14,7 @@ from racon_tpu.utils import calibrate
 
 @pytest.fixture()
 def calib_dir(tmp_path, monkeypatch):
-    monkeypatch.setenv("RACON_TPU_CACHE_DIR", str(tmp_path / "xla"))
+    monkeypatch.setenv("RACON_TPU_CACHE_DIR", str(tmp_path / "cache"))
     monkeypatch.delenv("RACON_TPU_RECALIBRATE", raising=False)
     for v in ("RACON_TPU_RATE_POA_DEV", "RACON_TPU_RATE_POA_CPU",
               "RACON_TPU_RATE_ALIGN_DEV", "RACON_TPU_RATE_ALIGN_CPU"):

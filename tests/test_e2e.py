@@ -201,3 +201,10 @@ def test_invalid_polisher_inputs(reference_data):
             os.path.join(reference_data, "sample_reads.fastq.gz"),
             "b.bed", "c.fa", PolisherType.kC, 500, 10, 0.3, True, 5, -4,
             -8, 1)
+    # bad TARGET file extension (the reference death-tests all three
+    # inputs, test/racon_test.cpp:55-86)
+    with pytest.raises(UnsupportedFormatError):
+        create_polisher(
+            os.path.join(reference_data, "sample_reads.fastq.gz"),
+            os.path.join(reference_data, "sample_overlaps.paf.gz"),
+            "c.bam", PolisherType.kC, 500, 10, 0.3, True, 5, -4, -8, 1)
