@@ -45,14 +45,14 @@ def read_fasta_gz(path):
 
 
 def run_polish(tpu_poa_batches=0, tpu_aligner_batches=0, threads=8,
-               banded=False):
+               banded=False, window_length=500):
     from racon_tpu.core.polisher import PolisherType, create_polisher
 
     polisher = create_polisher(
         os.path.join(DATA, "sample_reads.fastq.gz"),
         os.path.join(DATA, "sample_overlaps.paf.gz"),
         os.path.join(DATA, "sample_layout.fasta.gz"),
-        PolisherType.kC, 500, 10.0, 0.3, True, 5, -4, -8,
+        PolisherType.kC, window_length, 10.0, 0.3, True, 5, -4, -8,
         num_threads=threads, tpu_poa_batches=tpu_poa_batches,
         tpu_banded_alignment=banded,
         tpu_aligner_batches=tpu_aligner_batches)
@@ -190,19 +190,37 @@ def main():
         tpu_ok = False
 
     if tpu_ok:
-        # -b narrow-band variant (cudapoa banded-flag analog): measure
-        # its wall + accuracy so the speed/quality trade is on record.
-        # Isolated try: a banded-only failure (fresh compiles) must not
-        # discard the successful cold/warm results above.
+        # -b narrow-band variant (cudapoa banded-flag analog), measured
+        # at w=1000 where the band is a real lever: the auto band for
+        # the 2048 layer cap is 512 columns and -b halves it to 256,
+        # cutting the lockstep engine's vector width in half (at the
+        # default w=500 both bands sit at the 256 placement floor, so
+        # -b is documented as an identity there -- see
+        # racon_tpu/utils/tuning.py:poa_band_cols).  w=1000 is also
+        # the config where the reference's CUDA path loses 3x quality
+        # (4168 vs CPU 1289, test/racon_test.cpp:400), so both walls
+        # AND both distances go on record.  Isolated try: a
+        # banded-only failure must not discard the results above.
         try:
-            banded_wall, banded_out, bpol = run_polish(
-                tpu_poa_batches=1, tpu_aligner_batches=1, banded=True)
-            banded_dist = accuracy(banded_out)
-            log(f"[bench] TPU path (-b narrow band): {banded_wall:.2f}s, "
-                f"edit distance {banded_dist}, poa stage "
-                f"{bpol.stage_walls.get('device_poa', 0.0):.2f}s")
-            extra["banded_wall_s"] = round(banded_wall, 3)
-            extra["banded_edit_distance"] = int(banded_dist)
+            if _budget_left(150, "w=1000 default/banded legs"):
+                w1k_wall, w1k_out, _ = run_polish(
+                    tpu_poa_batches=1, tpu_aligner_batches=1,
+                    window_length=1000)
+                w1k_dist = accuracy(w1k_out)
+                banded_wall, banded_out, bpol = run_polish(
+                    tpu_poa_batches=1, tpu_aligner_batches=1,
+                    banded=True, window_length=1000)
+                banded_dist = accuracy(banded_out)
+                log(f"[bench] w=1000 default band: {w1k_wall:.2f}s, "
+                    f"edit distance {w1k_dist} (reference CPU 1289 / "
+                    "CUDA 4168, racon_test.cpp:400)")
+                log(f"[bench] w=1000 -b half band: {banded_wall:.2f}s, "
+                    f"edit distance {banded_dist}, poa stage "
+                    f"{bpol.stage_walls.get('device_poa', 0.0):.2f}s")
+                extra["w1000_wall_s"] = round(w1k_wall, 3)
+                extra["w1000_edit_distance"] = int(w1k_dist)
+                extra["banded_wall_s"] = round(banded_wall, 3)
+                extra["banded_edit_distance"] = int(banded_dist)
         except Exception as exc:
             log(f"[bench] banded variant skipped "
                 f"({type(exc).__name__}: {exc})")

@@ -390,15 +390,15 @@ class TPUPoaBatchEngine:
     def __init__(self, match: int, mismatch: int, gap: int,
                  vcap: int = 2048, pcap: int = 16, lcap: int = 1024,
                  kcap: int = 128, max_depth: int = 200,
-                 band_cols: int = 0, mesh=None):
+                 banded: bool = False, mesh=None):
         self.match, self.mismatch, self.gap = match, mismatch, gap
         self.vcap, self.pcap, self.lcap = vcap, pcap, lcap
         self.kcap = kcap
         self.max_depth = max_depth
-        # band_cols: DP band width (columns) for the banded kernel;
-        # 0 = auto (quarter of the layer bucket, floor 256).  The -b
-        # flag narrows it (cudapoa banded analog, cudabatch.cpp:54-62).
-        self.band_cols = band_cols
+        # banded (-b): halve the auto quarter-of-bucket DP band
+        # (cudapoa banded analog, cudabatch.cpp:54-62); see
+        # racon_tpu.utils.tuning.poa_band_cols for the 256 floor
+        self.banded = banded
         self.cells = 0
         # mesh: shard each round's batch axis over the devices
         # (reference analog: per-device POA batch queues,
@@ -498,7 +498,7 @@ class TPUPoaBatchEngine:
         from racon_tpu.utils.tuning import pow2_at_least
 
         lp = self.lcap
-        wb = poa_pallas.band_width(lp, self.band_cols)
+        wb = poa_pallas.band_width(lp, self.banded)
         depth = max((min(len(w.sequences) - 1, self.max_depth)
                      for w in windows), default=0)
         d1 = max(8, pow2_at_least(depth + 1, 8))
@@ -548,7 +548,7 @@ class TPUPoaBatchEngine:
         v, lp = self.vcap, self.lcap
         # -b narrows the band; the on-device DP needs >= 256 columns
         # (quantum 128), so the narrow setting clamps up
-        wb = poa_pallas.band_width(lp, self.band_cols)
+        wb = poa_pallas.band_width(lp, self.banded)
         d1 = max(8, pow2_at_least(
             max((len(ll) for ll in layer_lists), default=0) + 1, 8))
         b_pad = max(8, pow2_at_least(n, 8))
@@ -786,7 +786,7 @@ class TPUPoaBatchEngine:
     def _band_cols(self, l_b: int) -> int:
         """Effective band width for layer bucket ``l_b`` (0 = unbanded:
         the band would cover the whole row anyway)."""
-        return poa_band_cols(l_b, self.band_cols)
+        return poa_band_cols(l_b, self.banded)
 
     def _dispatch(self, bases, preds, nrows, sinks, seq_arr, slen):
         # bucket this round's static dims to the active maxima so scan

@@ -82,22 +82,16 @@ def available() -> bool:
         return False
 
 
-def band_width(lp: int, band_cols: int = 0) -> int:
-    """The on-device DP band width for layer cap ``lp`` (same clamp
-    the engine and the shape-prediction prewarm must agree on).
+def band_width(lp: int, banded: bool = False) -> int:
+    """The on-device DP band width for layer cap ``lp``: the shared
+    band policy (racon_tpu.utils.tuning.poa_band_cols -- one source
+    of truth with the lockstep engine and the memory/prewarm shape
+    predictions) rounded up to the 128-lane quantum and clamped to
+    the padded row."""
+    from racon_tpu.utils.tuning import poa_band_cols
 
-    An explicit ``band_cols`` (the CLI's -b, engine default 128) is
-    honored down to one 128-lane quantum -- the cudapoa banded-kernel
-    analog (reference: src/cuda/cudabatch.cpp:54-62 selects a
-    genuinely narrower kernel under -b); alignments that fall out of
-    the narrow band fail to the CPU engine per the reject contract.
-    The auto band (band_cols 0) keeps the quarter-of-cap, floor-256
-    shape."""
-    if band_cols:
-        wb = max(128, (band_cols + 127) & ~127)
-    else:
-        wb = max(256, (lp // 4 + 127) & ~127)
-    return min(wb, ((lp + 127) & ~127))
+    wb = poa_band_cols(lp, banded) or (lp + 1)   # 0 = degenerate
+    return min((wb + 127) & ~127, ((lp + 127) & ~127))
 
 
 def prewarm(b: int, d1: int, *, v: int, lp: int, wb: int,
@@ -131,14 +125,15 @@ def fits(v: int, lp: int, d1: int, p: int, s: int, a: int,
     cap) use the lockstep engine instead of failing to compile."""
     vmem = (v * wb * 8                        # ring f32 + dirs i32
             + v * (p + s) * 4                 # adjacency ids (VMEM)
+            + v * a * 4                       # aligned groups
             + 2 * 8 * (lp + 256) * 4          # staged chw + chars rows
             + (wb + _N_SHIFT * 128) * 4       # pred-fold staging row
             + 2 * 2 * d1 * lp * 4             # seq/wts blocks x2 buf
             + 2 * v * 128 * 4)                # cons out x2 buf
-    # SMEM: per-node scalars + mirrors + weights + the packed path +
-    # the layer chw mirror; configs past the budget fail over to the
-    # lockstep engine instead of dying in the Mosaic compiler
-    smem = (v * (p + 2 * s + a + 12 + 13)
+    # SMEM: per-node scalars + pred mirror + weights + the packed
+    # path + the layer chw mirror; configs past the budget fail over
+    # to the lockstep engine instead of dying in the Mosaic compiler
+    smem = (v * (p + 8 + 13)
             + (v + lp) + 8 * (lp + 256) + d1 * 8) * 4
     return vmem <= (13 << 20) and smem <= (768 << 10)
 
@@ -147,11 +142,11 @@ def _kernel(nlay_ref, bblen_ref,
             seqs_ref, wts_ref, meta_ref,
             cons_ref, mout_ref,
             preds_v, succs_v, stage_v,
-            ring_v, dirs, accs, arga, chw_v, chars_v,
+            ring_v, dirs, accs, arga, chw_v, chars_v, aligsm_v,
             base_s, anch_s, nseq_s, nxt_s, glast_s,
-            bandq_s, pcnt_s, scnt_s, predsm_s, succsm_s, order_s,
-            score_s, cpred_s, predw_s, succw_s, pslot_s,
-            path_s, aligsm_s, gcnt_s, regs_s,
+            bandq_s, pcnt_s, scnt_s, predsm_s, order_s,
+            score_s, cpred_s, predw_s,
+            path_s, gcnt_s, regs_s,
             minsucc_s, chw_s, sem, *,
             v: int, lp: int, d1: int, p: int, s_: int, a_: int,
             k: int, wb: int,
@@ -183,6 +178,7 @@ def _kernel(nlay_ref, bblen_ref,
     colsg = colsf * jnp.float32(gap)
     iota_p = lax.broadcasted_iota(jnp.int32, (1, p), 1)
     iota_s = lax.broadcasted_iota(jnp.int32, (1, s_), 1)
+    iota_a = lax.broadcasted_iota(jnp.int32, (1, a_), 1)
     iota_c128 = lax.broadcasted_iota(jnp.int32, (1, 128), 1)
     # path pack radix: entry = (node+2)*pkr + (spos+2); spos < lp and
     # node < v, so pkr must clear lp (the wrapper asserts the product
@@ -266,14 +262,14 @@ def _kernel(nlay_ref, bblen_ref,
         scnt_s[j] = jnp.where(j + 1 < bbl, 1, 0)
         minsucc_s[j] = jnp.where(j + 1 < bbl, j + 1, _INF32)
         predsm_s[j * 8] = j - 1
-        succsm_s[j * 4] = jnp.where(j + 1 < bbl, j + 1, -1)
 
         @pl.when(j > 0)
         def _():
             # chain ids/anchors were written vectorized above; only
-            # the data-dependent weights + slot mirror are per-node
-            succw_s[(j - 1) * s_] = prev_w + w
-            pslot_s[(j - 1) * s_] = jnp.int32(0)
+            # the data-dependent weight is per-node (pred-side only:
+            # consensus scores in-edges, so succ weights would be
+            # dead state -- racon_tpu/native/poa_graph.hpp keeps both
+            # but only reads pred weights in consensus_path too)
             predw_s[j * p] = prev_w + w
         return w
 
@@ -318,7 +314,7 @@ def _kernel(nlay_ref, bblen_ref,
             regs_s[2] = nid + 1
             insert_after(pos, nid)
 
-        @pl.when(jnp.logical_not(ok))
+        @pl.when(jnp.logical_not(ok) & (regs_s[0] == 0))
         def _():
             regs_s[0] = jnp.int32(FAIL_VCAP)
         return jnp.where(ok, nid, 0)
@@ -326,37 +322,35 @@ def _kernel(nlay_ref, bblen_ref,
     def add_edge(u, t, w):
         """poa_graph.hpp add_edge: accumulate weight on an existing
         u->t edge else append.  The accumulate (the per-path-step hot
-        case) is pure SMEM: the hit search walks the <=4-slot succ id
-        mirror (scalar reads, no vector->scalar sync), the weight
-        bump and its pred-side mirror (located via the pslot mirror
-        recorded at edge creation) are scalar writes."""
-        sc_ = scnt_s[u]
+        case) is pure SMEM: the hit search walks t's <=8-slot PRED id
+        mirror (scalar reads, no vector->scalar sync; in-degree is 1
+        for most nodes so the first probe usually decides).  Only the
+        pred-side weight exists: consensus scores in-edges only."""
+        pc_ = pcnt_s[t]
         found = jnp.int32(-1)
-        for tt in range(3, -1, -1):     # descending: first hit wins
-            found = jnp.where((tt < sc_) & (succsm_s[u * 4 + tt] == t),
-                              tt, found)
+        for pp in range(7, -1, -1):     # descending: first hit wins
+            found = jnp.where((pp < pc_) & (predsm_s[t * 8 + pp] == u),
+                              pp, found)
 
         def deep_search(_):
-            # rare: out-degree > 4, search the full VMEM id row
-            srow = vload(succs_v, u)
-            return min_idx(srow == t, s_, iota_s)
+            # rare: in-degree > 8, search the full VMEM id row
+            prow = vload(preds_v, t)
+            return min_idx(prow == u, p, iota_p)
 
         def mirror_hit(_):
-            return jnp.where(found >= 0, found, s_)
+            return jnp.where(found >= 0, found, p)
 
-        hit = lax.cond((found < 0) & (sc_ > 4), deep_search,
+        hit = lax.cond((found < 0) & (pc_ > 8), deep_search,
                        mirror_hit, 0)
 
-        @pl.when(hit < s_)
+        @pl.when(hit < p)
         def _():
-            hs = u * s_ + hit
-            succw_s[hs] = succw_s[hs] + w
-            hp = t * p + pslot_s[hs]
+            hp = t * p + hit
             predw_s[hp] = predw_s[hp] + w
 
-        @pl.when(hit >= s_)
+        @pl.when(hit >= p)
         def _():
-            free = sc_
+            free = scnt_s[u]
             prow = vload(preds_v, t)
             pfree = pcnt_s[t]
             okk = (free < s_) & (pfree < p)
@@ -369,22 +363,20 @@ def _kernel(nlay_ref, bblen_ref,
                 minsucc_s[u] = jnp.minimum(minsucc_s[u], anch_s[t])
                 preds_v[pl.ds(t, 1), :] = jnp.where(iota_p == pfree, u,
                                                     prow)
-                succw_s[u * s_ + free] = w
-                pslot_s[u * s_ + free] = pfree
                 predw_s[t * p + pfree] = w
                 scnt_s[u] = free + 1
                 pcnt_s[t] = pfree + 1
-
-                @pl.when(free < 4)
-                def _():
-                    succsm_s[u * 4 + free] = t
 
                 @pl.when(pfree < 8)
                 def _():
                     predsm_s[t * 8 + pfree] = u
 
-            @pl.when(jnp.logical_not(okk))
+            @pl.when(jnp.logical_not(okk) & (regs_s[0] == 0))
             def _():
+                # don't overwrite an earlier fail (a vcap overflow
+                # returns node 0 as the merge target, whose slots then
+                # overflow too -- without the guard every vcap reject
+                # gets misreported as a pcap reject)
                 regs_s[0] = jnp.int32(FAIL_EDGE)
 
     # ---- per-layer loop ---------------------------------------------
@@ -515,10 +507,20 @@ def _kernel(nlay_ref, bblen_ref,
                     # in-subset counter: sq is monotone along the topo
                     # list, so a successor's band never lags any
                     # predecessor's (the dq >= 0 invariant), exactly
-                    # like the pre-fusion two-pass design
-                    sq_r = jnp.clip(
-                        (((nvis * slope_q8) >> 8) - (q // 2)) >> 7,
-                        0, smax_q)
+                    # like the pre-fusion two-pass design.  Subset
+                    # SINKS snap to the last quantum: their row is
+                    # only ever read at column m - s_r (the inline
+                    # sink fold below), and the floor-quantized
+                    # interpolation can misplace by up to q-1 columns,
+                    # which at narrow bands (-b, wb == q) would push
+                    # the end column out of every sink's band and fail
+                    # the window
+                    is_sink_n = minsucc_s[node] > end_eff
+                    sq_r = jnp.where(
+                        is_sink_n, smax_q,
+                        jnp.clip(
+                            (((nvis * slope_q8) >> 8) - (q // 2)) >> 7,
+                            0, smax_q))
                     s_r = sq_r * q
                     pid0 = jnp.where(cnt > 0, predsm_s[node * 8], -1)
                     val0, sqp0 = slot_meta(pid0, cnt, 0)
@@ -702,18 +704,18 @@ def _kernel(nlay_ref, bblen_ref,
                     def t_aligned(_):
                         # mismatch: reuse an aligned sibling with the
                         # same base else create one (poa_graph.hpp
-                        # aligned-group branch); groups are SMEM
-                        # count+id lists, so the search is scalar
+                        # aligned-group branch).  Group lists live in
+                        # VMEM as (sib * 256 + sib_base) entries: the
+                        # base tag makes the same-base search one
+                        # vector compare + extract, and group members
+                        # have distinct bases by construction so at
+                        # most one entry matches
                         gc = gcnt_s[nid]
-                        found = jnp.int32(-1)
-                        for aa in range(a_ - 1, -1, -1):
-                            # slots >= gc hold stale garbage; clamp
-                            # before indexing base_s (OOB SMEM reads
-                            # are UB on hardware even when masked out)
-                            sib = jnp.clip(aligsm_s[nid * a_ + aa],
-                                           0, v - 1)
-                            okb = (aa < gc) & (base_s[sib] == c)
-                            found = jnp.where(okb, sib, found)
+                        arow = vload(aligsm_v, nid)
+                        h = e11(jnp.min(jnp.where(
+                            (arow % 256 == c) & (iota_a < gc),
+                            arow // 256, v), axis=1, keepdims=True))
+                        found = jnp.where(h < v, h, -1)
 
                         def mk_new(_):
                             tgt = new_node(c, anch_s[nid],
@@ -726,31 +728,34 @@ def _kernel(nlay_ref, bblen_ref,
                             @pl.when(gc < a_)
                             def _():
                                 # tgt's group = nid's members + nid
-                                def cp(aa, _):
-                                    aligsm_s[tgt * a_ + aa] = \
-                                        aligsm_s[nid * a_ + aa]
-                                    return 0
-
-                                lax.fori_loop(0, gc, cp, 0)
-                                aligsm_s[tgt * a_ + gc] = nid
+                                nb = base_s[nid]
+                                aligsm_v[pl.ds(tgt, 1), :] = jnp.where(
+                                    iota_a == gc, nid * 256 + nb, arow)
                                 gcnt_s[tgt] = gc + 1
 
                                 # append tgt to each member (groups
                                 # already full skip the append, like
                                 # the full-row no-op store before)
                                 def ap(aa, _):
-                                    sib = aligsm_s[nid * a_ + aa]
+                                    sib = e11(jnp.sum(jnp.where(
+                                        iota_a == aa, arow, 0), axis=1,
+                                        keepdims=True)) // 256
                                     gs = gcnt_s[sib]
 
                                     @pl.when(gs < a_)
                                     def _():
-                                        aligsm_s[sib * a_ + gs] = tgt
+                                        srow_a = vload(aligsm_v, sib)
+                                        aligsm_v[pl.ds(sib, 1), :] = \
+                                            jnp.where(iota_a == gs,
+                                                      tgt * 256 + c,
+                                                      srow_a)
                                         gcnt_s[sib] = gs + 1
                                     glast_s[sib] = tgt
                                     return 0
 
                                 lax.fori_loop(0, gc, ap, 0)
-                                aligsm_s[nid * a_ + gc] = tgt
+                                aligsm_v[pl.ds(nid, 1), :] = jnp.where(
+                                    iota_a == gc, tgt * 256 + c, arow)
                                 gcnt_s[nid] = gc + 1
                                 glast_s[nid] = tgt
                             return tgt
@@ -948,6 +953,7 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
             pltpu.VMEM((8, wb), jnp.int32),      # arga
             pltpu.VMEM((8, lp + 256), jnp.int32),  # staged chr*256+wt
             pltpu.VMEM((8, lp + 256), jnp.int32),  # staged chars only
+            pltpu.VMEM((v, a_), jnp.int32),      # aligned groups
             pltpu.SMEM((v,), jnp.int32),         # base
             pltpu.SMEM((v,), jnp.int32),         # anchor
             pltpu.SMEM((v,), jnp.int32),         # nseqs
@@ -957,15 +963,11 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
             pltpu.SMEM((v,), jnp.int32),         # pred count
             pltpu.SMEM((v,), jnp.int32),         # succ count
             pltpu.SMEM((8 * v,), jnp.int32),     # pred id mirror
-            pltpu.SMEM((4 * v,), jnp.int32),     # succ id mirror
             pltpu.SMEM((v,), jnp.int32),         # order
             pltpu.SMEM((v,), jnp.int32),         # consensus score
             pltpu.SMEM((v,), jnp.int32),         # consensus pred
             pltpu.SMEM((v * p,), jnp.int32),     # pred weights
-            pltpu.SMEM((v * s_,), jnp.int32),    # succ weights
-            pltpu.SMEM((v * s_,), jnp.int32),    # succ->pred slot
             pltpu.SMEM((v + lp,), jnp.int32),    # packed path
-            pltpu.SMEM((v * a_,), jnp.int32),    # aligned-group ids
             pltpu.SMEM((v,), jnp.int32),         # aligned-group count
             pltpu.SMEM((12,), jnp.int32),        # regs
             pltpu.SMEM((v,), jnp.int32),         # min succ anchor

@@ -131,7 +131,7 @@ class TPUPolisher(Polisher):
             limit = 8 << 30  # backends without memory stats (CPU mesh)
         from racon_tpu.utils.tuning import poa_band_cols
         wb = poa_band_cols(
-            lcap, 128 if self.tpu_banded_alignment else 0) or (lcap + 1)
+            lcap, self.tpu_banded_alignment) or (lcap + 1)
         # per-lane round footprint: direction tape + score ring +
         # predecessor lists + candidate temporaries (x2 safety)
         bytes_per_lane = 2 * (vcap * wb + 128 * wb * 4
@@ -144,7 +144,13 @@ class TPUPolisher(Polisher):
     def _poa_caps(self):
         """Device cap selection: power-of-two graph/layer caps scaled
         from the window length (the CUDA analog sizes batches from free
-        GPU memory, src/cuda/cudapolisher.cpp:231-242)."""
+        GPU memory, src/cuda/cudapolisher.cpp:231-242).
+
+        The graph-node cap stays 4x the window length regardless of
+        -b: measured r5, real 30x-coverage windows need ~2.5-3x
+        window length in graph nodes (a vcap of 2x rejected 41/41
+        sample windows), so banding narrows only the DP band
+        (poa_band_cols), not the graph."""
         w = self.window_length
         vcap = self._bucket_dim(4 * w)
         lcap = self._bucket_dim(2 * w)
@@ -185,7 +191,7 @@ class TPUPolisher(Polisher):
         engine = TPUPoaBatchEngine(
             self.match, self.mismatch, self.gap, vcap=vcap, pcap=16,
             lcap=lcap, kcap=128, max_depth=self.MAX_DEPTH_PER_WINDOW,
-            band_cols=128 if self.tpu_banded_alignment else 0,
+            banded=self.tpu_banded_alignment,
             mesh=self.mesh)
 
         # trivial windows (<3 sequences) keep the backbone and count as
@@ -425,8 +431,7 @@ class TPUPolisher(Polisher):
         d1_top = max(8, pow2_at_least(max_depth + 1, 8))
         d1s = sorted({d1_top, max(8, d1_top // 2)})
         vcap, lcap = self._poa_caps()
-        wb = poa_pallas.band_width(
-            lcap, 128 if self.tpu_banded_alignment else 0)
+        wb = poa_pallas.band_width(lcap, self.tpu_banded_alignment)
         n_dev = len(self.mesh.devices)
         n_win = sum(length // self.window_length + 1
                     for length in tlen.values())

@@ -26,12 +26,20 @@ def pow2_at_least(n: int, floor: int) -> int:
     return b
 
 
-def poa_band_cols(l_bucket: int, band_cols: int = 0) -> int:
+def poa_band_cols(l_bucket: int, banded: bool = False) -> int:
     """Effective POA band width for a layer bucket (0 = unbanded).
 
-    ``band_cols`` 0 selects the auto band (quarter of the bucket,
-    floor 256); the CLI's -b narrows it (the engine passes 128).  A
-    band at least as wide as the whole row degenerates to unbanded.
-    """
-    wb = band_cols if band_cols else max(256, l_bucket // 4)
+    The auto band is a quarter of the bucket; the CLI's -b halves it
+    to an eighth (the cudapoa banded-kernel analog,
+    reference src/cuda/cudabatch.cpp:54-62).  Both floor at 256
+    columns: the device band quantum is 128 and placement centers the
+    expected diagonal half a quantum into the band, so 256 is the
+    narrowest band that keeps the diagonal in reach (measured r5: a
+    128 band rejects every sample window).  At the default window
+    length both bands therefore coincide; -b bites from window length
+    1000 up -- where it also shrinks the flagship kernel's VMEM
+    footprint enough to keep it in play instead of the lockstep
+    fallback.  A band at least as wide as the whole row degenerates
+    to unbanded."""
+    wb = max(256, l_bucket // (8 if banded else 4))
     return 0 if wb >= l_bucket + 1 else wb

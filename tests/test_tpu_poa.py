@@ -52,18 +52,41 @@ def test_device_poa_recovers_truth(depth, rate):
             f"cpu-engine distance {d_cpu}"
 
 
-@pytest.mark.parametrize("band_cols", [0, 128])
-def test_banded_device_poa_matches_cpu(band_cols):
+def test_banded_device_poa_matches_cpu():
     """Realistic window-length layers (~550 bp -> l bucket 1024) so the
-    banded kernel actually engages (auto band 256 < l_b+1), at both the
-    auto and the -b narrow band width."""
+    banded kernel actually engages (auto band 256 < l_b+1)."""
     rng = random.Random(21)
     truth = random_seq(550, rng)
     windows = [make_window(truth, 10, 0.1, rng) for _ in range(2)]
 
-    eng = TPUPoaBatchEngine(5, -4, -8, vcap=2048, pcap=16, lcap=1024,
-                            band_cols=band_cols)
-    assert eng._band_cols(1024) == (band_cols or 256)  # banding active
+    eng = TPUPoaBatchEngine(5, -4, -8, vcap=2048, pcap=16, lcap=1024)
+    assert eng._band_cols(1024) == 256
+    results = eng.consensus_batch(windows, trim=True)
+    for w, (cons, ok) in zip(windows, results):
+        assert ok and cons is not None
+        d_truth = cpu.edit_distance(cons, truth)
+        d_cpu = cpu.edit_distance(cons, cpu_consensus(w))
+        assert d_truth <= max(2, int(0.02 * len(truth))), \
+            f"truth distance {d_truth}"
+        assert d_cpu <= max(2, int(0.02 * len(truth))), \
+            f"cpu-engine distance {d_cpu}"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("banded", [False, True])
+def test_narrow_band_w1000_matches_cpu(banded):
+    """The -b trade at the w=1000-class config where it is real: the
+    2048 layer bucket's auto band is 512 columns and -b halves it to
+    256 (racon_tpu/utils/tuning.py:poa_band_cols), the config the
+    bench's w1000/banded legs measure.  Both bands must reproduce the
+    CPU engine's consensus on ~1100 bp layers."""
+    rng = random.Random(33)
+    truth = random_seq(1100, rng)
+    windows = [make_window(truth, 8, 0.08, rng)]
+
+    eng = TPUPoaBatchEngine(5, -4, -8, vcap=4096, pcap=16, lcap=2048,
+                            banded=banded)
+    assert eng._band_cols(2048) == (256 if banded else 512)
     results = eng.consensus_batch(windows, trim=True)
     for w, (cons, ok) in zip(windows, results):
         assert ok and cons is not None
