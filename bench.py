@@ -113,12 +113,20 @@ def main():
     if os.environ.get("RACON_TPU_BENCH_PREBUILD", "1") == "1":
         import subprocess
         t0 = time.monotonic()
-        r = subprocess.run([sys.executable, "-m", "racon_tpu.prebuild"],
-                           cwd=REPO, capture_output=True, text=True)
-        tail = [ln for ln in r.stderr.strip().splitlines()
-                if ln.startswith("[prebuild]")][-1:]
-        log(f"[bench] prebuild (untimed install step, rc={r.returncode},"
-            f" {time.monotonic() - t0:.1f}s): {''.join(tail)}")
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "racon_tpu.prebuild"],
+                cwd=REPO, capture_output=True, text=True, timeout=600)
+        except subprocess.TimeoutExpired:
+            log("[bench] prebuild timed out after 600s; continuing "
+                "with cold kernels")
+            r = None
+        if r is not None:
+            tail = [ln for ln in r.stderr.strip().splitlines()
+                    if ln.startswith("[prebuild]")][-1:]
+            log(f"[bench] prebuild (untimed install step, "
+                f"rc={r.returncode}, {time.monotonic() - t0:.1f}s): "
+                f"{''.join(tail)}")
 
     import jax
     log(f"[bench] jax devices: {jax.devices()}")

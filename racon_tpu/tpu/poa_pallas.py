@@ -1,25 +1,40 @@
 """Full on-device batched POA: the flagship Pallas TPU kernel.
 
-One grid program per window runs the ENTIRE partial-order-alignment
-consensus -- graph construction, per-layer banded DP, traceback, graph
-merge, heaviest-bundle consensus, TGS trim -- with the POA graph
-resident in VMEM/SMEM.  This is the cudapoa architecture (reference:
-one CUDA thread block per POA group, src/cuda/cudabatch.cpp:52-265)
-mapped to the TensorCore: host involvement is ONE upload of the layer
-sequences and ONE download of the finished consensus per megabatch.
+One grid program runs the ENTIRE partial-order-alignment consensus --
+graph construction, per-layer banded DP, traceback, graph merge,
+heaviest-bundle consensus, TGS trim -- for a PAIR of windows, with
+both POA graphs resident in VMEM/SMEM.  This is the cudapoa
+architecture (reference: one CUDA thread block per POA group,
+src/cuda/cudabatch.cpp:52-265) mapped to the TensorCore: host
+involvement is ONE upload of the layer sequences and ONE download of
+the finished consensus per megabatch.
 
-Why not the lockstep host-graph design (racon_tpu/tpu/poa.py)?  On the
-tunneled-TPU deployment target, host<->device transfers cost ~100 ms
-latency each way regardless of size; the lockstep engine pays two per
-layer round (~38 rounds on the reference sample workload), which
-dominates its wall clock.  This kernel pays two per megabatch.
+Why a pair per program?  The per-rank DP is a serial dependency chain
+(pred row -> fold -> move max -> log2(wb) gap-chain steps -> row
+store), and measurement shows the kernel is bound by that chain's
+LATENCY, not by op count or vector width: duplicating any individual
+phase inside the rank body costs ~nothing (the VLIW scheduler hides
+it in the chain's stalls), while running the whole walk twice costs
+the full +78%.  A second window's chain is exactly such independent
+work: interleaving two windows' rank bodies in one straight-line
+region lets the scheduler fill one chain's stalls with the other's
+ops, targeting ~2x per-window throughput at unchanged op count.
+Scaling past 2 is capped by SMEM: each window's per-node scalars
+(~37 ints/node after the r5 diet) must stay scalar-addressable.
 
-Graph representation (per program, V node slots):
+Why not the lockstep host-graph design (racon_tpu/tpu/poa.py)?  On
+the tunneled-TPU deployment target, host<->device transfers cost
+~100 ms latency each way regardless of size; the lockstep engine pays
+two per layer round (~38 rounds on the reference sample workload),
+which dominates its wall clock.  This kernel pays two per megabatch.
+
+Graph representation (per window, V node slots):
 
 * per-node scalars in SMEM: base, anchor (backbone position), nseqs,
-  list-next, aligned-group-last, topo rank (epoch-tagged);
-* adjacency in VMEM int32 arrays: preds/pred weights [V,P], succs/succ
-  weights/succ anchors [V,S], aligned groups [V,A];
+  list-next, aligned-group-last, topo rank (epoch-tagged), pred id
+  mirror (8 slots) and pred weights;
+* adjacency ids in VMEM int32 arrays: preds [V,P], succs [V,S];
+  aligned groups [V,A] as base-tagged entries (sib * 256 + sib_base);
 * topological order is maintained as a singly-linked list grouped by
   alignment column: new columns insert after the previous path node's
   column, new aligned members insert adjacent to their column.  Edges
@@ -28,15 +43,15 @@ Graph representation (per program, V node slots):
   (spoa re-sorts per added sequence; cudapoa re-sorts on device).
 
 The per-layer DP is the same banded graph-vs-sequence recurrence as
-the scan kernels in poa.py (band quantum q = wb//4, pred rows fetched
-from a [K, wb] VMEM ring, in-row gap chain closed with a max-plus
+the scan kernels in poa.py (band quantum q = 128, pred rows fetched
+from per-node VMEM rows, in-row gap chain closed with a max-plus
 doubling scan), with first-slot-on-tie direction codes so tracebacks
 are deterministic.  Graph-semantics parity target is the native CPU
 engine (racon_tpu/native/poa_graph.hpp); like the CUDA path vs spoa,
 cost-equal alignment ties may resolve differently, so consensus
 equality is validated within an edit tolerance, not byte-for-byte.
 
-Windows that overflow any cap (V nodes, P/S edges, A aligned, K rank
+Windows that overflow any cap (V nodes, P/S edges, A aligned, band
 reach, path length) fail with a code and fall back to the CPU engine,
 the reference's rejection contract (cudabatch.cpp:124-155 ->
 cudapolisher.cpp:357-386).
@@ -58,13 +73,18 @@ from jax.experimental.pallas import tpu as pltpu
 _BIG = 1 << 28
 _N_SHIFT = 4          # pred band may lag <= 3 quanta of 128
 _INF32 = np.int32(2147483647 // 2)
+_S = 2                # windows per grid program (see module docstring)
 
 # fail codes (observability parity with the lockstep export codes)
 FAIL_VCAP = 1
 FAIL_EDGE = 2         # pred/succ slot overflow (pcap analog)
-FAIL_KCAP = 3         # pred rank reach > K
+FAIL_KCAP = 3         # band reach: pred band lagged out of shift
+                      # range, or no subset sink within band reach
 FAIL_ALIGNED = 4
 FAIL_PATH = 5
+
+_NREG = 16            # regs slots per window
+_PNEG = np.int32(-(1 << 24) * 64)   # packed -inf row value
 
 
 def available() -> bool:
@@ -119,50 +139,84 @@ def prewarm(b: int, d1: int, *, v: int, lp: int, wb: int,
 
 def fits(v: int, lp: int, d1: int, p: int, s: int, a: int,
          wb: int) -> bool:
-    """Conservative per-program VMEM estimate: ring + dirs (v x wb),
-    adjacency, lane-padded path/output refs, double-buffered input
-    blocks.  Configurations over budget (e.g. -w 1000 doubles every
-    cap) use the lockstep engine instead of failing to compile."""
-    vmem = (v * wb * 8                        # ring f32 + dirs i32
-            + v * (p + s) * 4                 # adjacency ids (VMEM)
-            + v * a * 4                       # aligned groups
+    """Conservative per-program VMEM/SMEM estimate for the PAIRED
+    kernel (two windows per program).  Configurations over budget
+    (e.g. -w 1000 doubles every cap) use the lockstep engine instead
+    of failing to compile."""
+    vmem = (_S * v * wb * 4                   # packed score|code rows
+            + _S * v * (p + s) * 4            # adjacency ids (VMEM)
+            + _S * v * a * 4                  # aligned groups
             + 2 * 8 * (lp + 256) * 4          # staged chw + chars rows
-            + (wb + _N_SHIFT * 128) * 4       # pred-fold staging row
-            + 2 * 2 * d1 * lp * 4             # seq/wts blocks x2 buf
-            + 2 * v * 128 * 4)                # cons out x2 buf
+            + _S * (wb + _N_SHIFT * 128) * 4  # pred-fold staging rows
+            + 2 * 2 * _S * d1 * lp * 4)       # seq/wts blocks x2 buf
     # SMEM: per-node scalars + pred mirror + weights + the packed
-    # path + the layer chw mirror; configs past the budget fail over
-    # to the lockstep engine instead of dying in the Mosaic compiler
-    smem = (v * (p + 8 + 13)
-            + (v + lp) + 8 * (lp + 256) + d1 * 8) * 4
-    return vmem <= (13 << 20) and smem <= (768 << 10)
+    # path per window, plus the shared chw mirror and the SMEM
+    # consensus outputs
+    smem = (_S * (v * (p + 8 + 13) + (v + lp) + v + 8)
+            + 8 * (lp + 256) + _S * d1 * 8) * 4
+    # the Mosaic scoped-vmem limit is 16M; leave ~5M for the
+    # compiler's stack temporaries (measured r5: the paired body's
+    # temps cost ~6M at d1=32 before the row packing)
+    return vmem <= (11 << 20) and smem <= (768 << 10)
 
 
 def _kernel(nlay_ref, bblen_ref,
             seqs_ref, wts_ref, meta_ref,
             cons_ref, mout_ref,
-            preds_v, succs_v, stage_v,
-            ring_v, dirs, accs, arga, chw_v, chars_v, aligsm_v,
-            base_s, anch_s, nseq_s, nxt_s, glast_s,
-            bandq_s, pcnt_s, scnt_s, predsm_s, order_s,
-            score_s, cpred_s, predw_s,
-            path_s, gcnt_s, regs_s,
-            minsucc_s, chw_s, sem, *,
+            preds_a, preds_b, succs_a, succs_b, stage_a, stage_b,
+            ring_a, ring_b, accs_a, accs_b, arga_a, arga_b,
+            chw_v, chars_v, aligsm_a, aligsm_b,
+            base_a, base_b, anch_a, anch_b, nseq_a, nseq_b,
+            nxt_a, nxt_b, glast_a, glast_b, bandq_a, bandq_b,
+            pcnt_a, pcnt_b, scnt_a, scnt_b, predsm_a, predsm_b,
+            order_a, order_b, score_a, score_b, cpred_a, cpred_b,
+            predw_a, predw_b, path_a, path_b, gcnt_a, gcnt_b,
+            regs_a, regs_b, minsucc_a, minsucc_b,
+            chw_s, cons_sm, sem, *,
             v: int, lp: int, d1: int, p: int, s_: int, a_: int,
             k: int, wb: int,
             match: int, mismatch: int, gap: int,
             wtype: int, trim: int):
     i = pl.program_id(0)
-    nlay = nlay_ref[i]
-    bbl = bblen_ref[i]
+    nlay_u = [nlay_ref[_S * i + u] for u in range(_S)]
+    bbl_u = [bblen_ref[_S * i + u] for u in range(_S)]
+    # every per-window array is its own ref: the two windows' walks
+    # interleave in one straight-line region, and DISTINCT refs are
+    # what lets the scheduler prove window B's loads cannot alias
+    # window A's stores (a shared ref with u*v offsets serializes the
+    # pair -- measured r5: zero speedup from pairing until the split)
+    preds_u = (preds_a, preds_b)
+    succs_u = (succs_a, succs_b)
+    stage_u = (stage_a, stage_b)
+    ring_u = (ring_a, ring_b)
+    accs_u = (accs_a, accs_b)
+    arga_u = (arga_a, arga_b)
+    aligsm_u = (aligsm_a, aligsm_b)
+    base_u = (base_a, base_b)
+    anch_u = (anch_a, anch_b)
+    nseq_u = (nseq_a, nseq_b)
+    nxt_u = (nxt_a, nxt_b)
+    glast_u = (glast_a, glast_b)
+    bandq_u = (bandq_a, bandq_b)
+    pcnt_u = (pcnt_a, pcnt_b)
+    scnt_u = (scnt_a, scnt_b)
+    predsm_u = (predsm_a, predsm_b)
+    order_u = (order_a, order_b)
+    score_u = (score_a, score_b)
+    cpred_u = (cpred_a, cpred_b)
+    predw_u = (predw_a, predw_b)
+    path_u = (path_a, path_b)
+    gcnt_u = (gcnt_a, gcnt_b)
+    regs_u = (regs_a, regs_b)
+    minsucc_u = (minsucc_a, minsucc_b)
 
     def stage_chw():
         """Copy the staged packed char*256+weight rows into SMEM: the
-        merge/seed phases read row 0 per position, and a scalar SMEM
+        merge/seed phases read row u per position, and a scalar SMEM
         read is ~20 ns where each vector->scalar lane extraction costs
-        a VPU sync (~1 us) -- the round-3 merge bottleneck.  The copy
-        moves the whole (8, lp+256) staging block because DMA slices
-        must be 8-sublane aligned; rows 1-7 are ballast."""
+        a VPU sync -- the round-3 merge bottleneck.  The copy moves
+        the whole (8, lp+256) staging block because DMA slices must be
+        8-sublane aligned; rows _S..7 are ballast."""
         cp = pltpu.make_async_copy(chw_v, chw_s, sem)
         cp.start()
         cp.wait()
@@ -179,48 +233,12 @@ def _kernel(nlay_ref, bblen_ref,
     iota_p = lax.broadcasted_iota(jnp.int32, (1, p), 1)
     iota_s = lax.broadcasted_iota(jnp.int32, (1, s_), 1)
     iota_a = lax.broadcasted_iota(jnp.int32, (1, a_), 1)
-    iota_c128 = lax.broadcasted_iota(jnp.int32, (1, 128), 1)
     # path pack radix: entry = (node+2)*pkr + (spos+2); spos < lp and
     # node < v, so pkr must clear lp (the wrapper asserts the product
     # fits int32)
     pkr = 1
     while pkr < lp + 8:
         pkr <<= 1
-
-    # ---- scratch bulk init (scratch persists across grid programs) --
-    # edge WEIGHTS (and the succ-slot -> pred-slot mirror) live in
-    # SMEM: the merge phase accumulates a weight on almost every path
-    # step, and a scalar SMEM R/W is ~20 ns where a dynamic-sublane
-    # VMEM row RMW is ~800 ns; weight slots are written at edge
-    # creation before any read, so they need no bulk init
-    iota_v0 = lax.broadcasted_iota(jnp.int32, (v, 1), 0)
-    bblm = jnp.minimum(bbl, v)
-    # backbone chain adjacency, vectorized (one column store each)
-    preds_v[:, :] = jnp.full((v, p), -1, jnp.int32)
-    preds_v[:, 0:1] = jnp.where((iota_v0 > 0) & (iota_v0 < bblm),
-                                iota_v0 - 1, -1)
-    succs_v[:, :] = jnp.full((v, s_), -1, jnp.int32)
-    succs_v[:, 0:1] = jnp.where(iota_v0 < bblm - 1, iota_v0 + 1, -1)
-    chw_v[:, :] = jnp.zeros((8, lp + 256), jnp.int32)
-    chars_v[:, :] = jnp.zeros((8, lp + 256), jnp.int32)
-    # the pred-fold staging row: [0, wb) is overwritten per fold, the
-    # [wb, wb + N_SHIFT*q) tail stays -inf so a lagging pred's shifted
-    # window reads -inf beyond its band (replaces the pad+4-select
-    # fold with one store + one 128-aligned dynamic-lane load)
-    stage_v[0:1, :] = jnp.full((1, wb + _N_SHIFT * q), negf,
-                               jnp.float32)
-
-    def init_bandq(j, _):
-        bandq_s[j] = jnp.int32(-1)
-        gcnt_s[j] = jnp.int32(0)
-        return 0
-
-    lax.fori_loop(0, v, init_bandq, 0)
-
-    # regs: 0 fail, 1 head, 2 nodes_len, 3 n_seqs_incl, 4 rank_steps
-    regs_s[0] = jnp.int32(0)
-    regs_s[3] = jnp.int32(1)
-    regs_s[4] = jnp.int32(0)
 
     def e11(val2d):
         """(1,1) value -> scalar."""
@@ -234,108 +252,156 @@ def _kernel(nlay_ref, bblen_ref,
         return e11(jnp.min(jnp.where(mask, iota_row, width),
                            axis=1, keepdims=True))
 
-    # ---- seed the backbone chain (add_alignment with an empty path:
-    # racon_tpu/native/poa_graph.hpp add_alignment initial branch) ----
-    @pl.when(bbl > v)
-    def _():
-        regs_s[0] = jnp.int32(FAIL_VCAP)
+    # ---- scratch bulk init (scratch persists across grid programs) --
+    iota_v0 = lax.broadcasted_iota(jnp.int32, (v, 1), 0)
+    bblm_u = [jnp.minimum(bbl_u[u], v) for u in range(_S)]
+    for u in range(_S):
+        # backbone chain adjacency, vectorized (one column store each)
+        preds_u[u][:, :] = jnp.full((v, p), -1, jnp.int32)
+        preds_u[u][:, 0:1] = jnp.where(
+            (iota_v0 > 0) & (iota_v0 < bblm_u[u]), iota_v0 - 1, -1)
+        succs_u[u][:, :] = jnp.full((v, s_), -1, jnp.int32)
+        succs_u[u][:, 0:1] = jnp.where(
+            iota_v0 < bblm_u[u] - 1, iota_v0 + 1, -1)
+        # the pred-fold staging row: [0, wb) is overwritten per fold,
+        # the [wb, wb + N_SHIFT*q) tail stays packed--inf so a lagging
+        # pred's shifted window reads -inf beyond its band (rows are
+        # packed score*64 | code, see epilogue)
+        stage_u[u][:, :] = jnp.full((4, wb + _N_SHIFT * q),
+                                    _PNEG, jnp.int32)
+    chw_v[:, :] = jnp.zeros((8, lp + 256), jnp.int32)
+    chars_v[:, :] = jnp.zeros((8, lp + 256), jnp.int32)
 
+    def init_nodes(j, _):
+        for u in range(_S):
+            bandq_u[u][j] = jnp.int32(-1)
+            gcnt_u[u][j] = jnp.int32(0)
+        return 0
+
+    lax.fori_loop(0, v, init_nodes, 0)
+
+    # regs: 0 fail, 1 head, 2 nodes_len, 3 n_seqs_incl, 4 rank_steps,
+    # 6 best sink node, 7 best sink score, 8 nreal, 9 nbad, 10 target
+    for u in range(_S):
+        regs_u[u][0] = jnp.int32(0)
+        regs_u[u][1] = jnp.int32(0)
+        regs_u[u][2] = bblm_u[u]
+        regs_u[u][3] = jnp.int32(1)
+        regs_u[u][4] = jnp.int32(0)
+
+        @pl.when(bbl_u[u] > v)
+        def _(u=u):
+            regs_u[u][0] = jnp.int32(FAIL_VCAP)
+
+    # ---- seed the backbone chains (add_alignment with an empty path:
+    # racon_tpu/native/poa_graph.hpp add_alignment initial branch) ----
     # stage char*256+weight in VMEM (the DP band load windows into it)
     # and mirror it into SMEM (seed/merge read per position)
-    chw_v[0:1, 0:lp] = seqs_ref[0, 0:1, :] * 256 + wts_ref[0, 0:1, :]
+    for u in range(_S):
+        chw_v[u:u + 1, 0:lp] = seqs_ref[u, 0:1, :] * 256 \
+            + wts_ref[u, 0:1, :]
     stage_chw()
 
-    def chw_at(j):
+    def chw_at(u, j):
         """(char, weight) at dynamic position j: scalar SMEM reads of
         the mirrored row, no VPU involvement."""
-        x = chw_s[0, j]
+        x = chw_s[u, j]
         return x // 256, x % 256
 
-    def seed(j, prev_w):
-        c, w = chw_at(j)
-        base_s[j] = c
-        anch_s[j] = j
-        nseq_s[j] = jnp.int32(1)
-        nxt_s[j] = jnp.where(j + 1 < bbl, j + 1, -1)
-        glast_s[j] = j
-        pcnt_s[j] = jnp.where(j > 0, 1, 0)
-        scnt_s[j] = jnp.where(j + 1 < bbl, 1, 0)
-        minsucc_s[j] = jnp.where(j + 1 < bbl, j + 1, _INF32)
-        predsm_s[j * 8] = j - 1
+    def seed_one(u, j, prev_w, act):
+        c, w = chw_at(u, j)
 
-        @pl.when(j > 0)
+        @pl.when(act)
         def _():
-            # chain ids/anchors were written vectorized above; only
-            # the data-dependent weight is per-node (pred-side only:
-            # consensus scores in-edges, so succ weights would be
-            # dead state -- racon_tpu/native/poa_graph.hpp keeps both
-            # but only reads pred weights in consensus_path too)
-            predw_s[j * p] = prev_w + w
-        return w
+            base_u[u][j] = c
+            anch_u[u][j] = j
+            nseq_u[u][j] = jnp.int32(1)
+            nxt_u[u][j] = jnp.where(j + 1 < bbl_u[u], j + 1, -1)
+            glast_u[u][j] = j
+            pcnt_u[u][j] = jnp.where(j > 0, 1, 0)
+            scnt_u[u][j] = jnp.where(j + 1 < bbl_u[u], 1, 0)
+            minsucc_u[u][j] = jnp.where(j + 1 < bbl_u[u], j + 1,
+                                        _INF32)
+            predsm_u[u][(j) * 8 + 0] = j - 1
 
-    lax.fori_loop(0, jnp.minimum(bbl, v), seed, jnp.int32(0))
-    regs_s[1] = jnp.int32(0)                   # list head
-    regs_s[2] = jnp.minimum(bbl, v)            # nodes_len
+            @pl.when(j > 0)
+            def _():
+                # chain ids/anchors were written vectorized above;
+                # only the data-dependent weight is per-node
+                # (pred-side only: consensus scores in-edges, so succ
+                # weights would be dead state)
+                predw_u[u][(j) * p + 0] = prev_w + w
+        return jnp.where(act, w, prev_w)
 
-    # ---- helpers shared by the merge step ---------------------------
+    def seed(j, carry):
+        ws = list(carry)
+        for u in range(_S):
+            ws[u] = seed_one(u, j, ws[u], j < bblm_u[u])
+        return tuple(ws)
 
-    def insert_after(pos, node):
+    lax.fori_loop(0, jnp.maximum(bblm_u[0], bblm_u[1]), seed,
+                  (jnp.int32(0),) * _S)
+
+    # ---- helpers shared by the merge step (u is a python int) -------
+
+    def insert_after(u, pos, node):
         """Linked-list insert; pos == -1 -> new head."""
         @pl.when(pos >= 0)
         def _():
-            nxt_s[node] = nxt_s[pos]
-            nxt_s[pos] = node
+            nxt_u[u][node] = nxt_u[u][pos]
+            nxt_u[u][pos] = node
 
         @pl.when(pos < 0)
         def _():
-            nxt_s[node] = regs_s[1]
-            regs_s[1] = node
+            nxt_u[u][node] = regs_u[u][1]
+            regs_u[u][1] = node
 
-    def new_node(c, anchor, pos):
+    def new_node(u, c, anchor, pos):
         """Allocate a node and insert it after list position pos."""
-        nid = regs_s[2]
+        nid = regs_u[u][2]
         ok = nid < v
 
         @pl.when(ok)
         def _():
-            base_s[nid] = c
-            anch_s[nid] = anchor
-            nseq_s[nid] = jnp.int32(0)
-            glast_s[nid] = nid
-            bandq_s[nid] = jnp.int32(-1)
+            base_u[u][nid] = c
+            anch_u[u][nid] = anchor
+            nseq_u[u][nid] = jnp.int32(0)
+            glast_u[u][nid] = nid
+            bandq_u[u][nid] = jnp.int32(-1)
             # slot 0 must be initialized: a zero-pred node's traceback
             # diag code still reads mirror slot 0 (cnt-bounded readers
             # cover slots >= 1 only)
-            predsm_s[nid * 8] = jnp.int32(-1)
-            pcnt_s[nid] = jnp.int32(0)
-            scnt_s[nid] = jnp.int32(0)
-            gcnt_s[nid] = jnp.int32(0)
-            minsucc_s[nid] = _INF32
-            regs_s[2] = nid + 1
-            insert_after(pos, nid)
+            predsm_u[u][(nid) * 8 + 0] = jnp.int32(-1)
+            pcnt_u[u][nid] = jnp.int32(0)
+            scnt_u[u][nid] = jnp.int32(0)
+            gcnt_u[u][nid] = jnp.int32(0)
+            minsucc_u[u][nid] = _INF32
+            regs_u[u][2] = nid + 1
+            insert_after(u, pos, nid)
 
-        @pl.when(jnp.logical_not(ok) & (regs_s[0] == 0))
+        @pl.when(jnp.logical_not(ok) & (regs_u[u][0] == 0))
         def _():
-            regs_s[0] = jnp.int32(FAIL_VCAP)
+            regs_u[u][0] = jnp.int32(FAIL_VCAP)
         return jnp.where(ok, nid, 0)
 
-    def add_edge(u, t, w):
+    def add_edge(u, nu, t, w):
         """poa_graph.hpp add_edge: accumulate weight on an existing
-        u->t edge else append.  The accumulate (the per-path-step hot
+        nu->t edge else append.  The accumulate (the per-path-step hot
         case) is pure SMEM: the hit search walks t's <=8-slot PRED id
         mirror (scalar reads, no vector->scalar sync; in-degree is 1
         for most nodes so the first probe usually decides).  Only the
         pred-side weight exists: consensus scores in-edges only."""
-        pc_ = pcnt_s[t]
+        pc_ = pcnt_u[u][t]
         found = jnp.int32(-1)
         for pp in range(7, -1, -1):     # descending: first hit wins
-            found = jnp.where((pp < pc_) & (predsm_s[t * 8 + pp] == u),
+            found = jnp.where((pp < pc_) &
+                              (predsm_u[u][(t) * 8 + pp] == nu),
                               pp, found)
 
         def deep_search(_):
             # rare: in-degree > 8, search the full VMEM id row
-            prow = vload(preds_v, t)
-            return min_idx(prow == u, p, iota_p)
+            prow = vload(preds_u[u], t)
+            return min_idx(prow == nu, p, iota_p)
 
         def mirror_hit(_):
             return jnp.where(found >= 0, found, p)
@@ -346,106 +412,128 @@ def _kernel(nlay_ref, bblen_ref,
         @pl.when(hit < p)
         def _():
             hp = t * p + hit
-            predw_s[hp] = predw_s[hp] + w
+            predw_u[u][hp] = predw_u[u][hp] + w
 
         @pl.when(hit >= p)
         def _():
-            free = scnt_s[u]
-            prow = vload(preds_v, t)
-            pfree = pcnt_s[t]
+            free = scnt_u[u][nu]
+            prow = vload(preds_u[u], t)
+            pfree = pcnt_u[u][t]
             okk = (free < s_) & (pfree < p)
 
             @pl.when(okk)
             def _():
-                srow = vload(succs_v, u)
-                succs_v[pl.ds(u, 1), :] = jnp.where(iota_s == free, t,
-                                                    srow)
-                minsucc_s[u] = jnp.minimum(minsucc_s[u], anch_s[t])
-                preds_v[pl.ds(t, 1), :] = jnp.where(iota_p == pfree, u,
-                                                    prow)
-                predw_s[t * p + pfree] = w
-                scnt_s[u] = free + 1
-                pcnt_s[t] = pfree + 1
+                srow = vload(succs_u[u], nu)
+                succs_u[u][pl.ds(nu, 1), :] = jnp.where(
+                    iota_s == free, t, srow)
+                minsucc_u[u][nu] = jnp.minimum(minsucc_u[u][nu],
+                                                  anch_u[u][t])
+                preds_u[u][pl.ds(t, 1), :] = jnp.where(
+                    iota_p == pfree, nu, prow)
+                predw_u[u][(t) * p + 0 + pfree] = w
+                scnt_u[u][nu] = free + 1
+                pcnt_u[u][t] = pfree + 1
 
                 @pl.when(pfree < 8)
                 def _():
-                    predsm_s[t * 8 + pfree] = u
+                    predsm_u[u][(t) * 8 + 0 + pfree] = nu
 
-            @pl.when(jnp.logical_not(okk) & (regs_s[0] == 0))
+            @pl.when(jnp.logical_not(okk) & (regs_u[u][0] == 0))
             def _():
                 # don't overwrite an earlier fail (a vcap overflow
                 # returns node 0 as the merge target, whose slots then
                 # overflow too -- without the guard every vcap reject
                 # gets misreported as a pcap reject)
-                regs_s[0] = jnp.int32(FAIL_EDGE)
+                regs_u[u][0] = jnp.int32(FAIL_EDGE)
 
-    # ---- per-layer loop ---------------------------------------------
+    # ---- per-layer loop (joint over the pair) -----------------------
 
     def layer(d, _):
-        @pl.when(regs_s[0] == 0)
+        act_u = [(regs_u[u][0] == 0) & (d <= nlay_u[u])
+                 for u in range(_S)]
+
+        @pl.when(act_u[0] | act_u[1])
         def _do_layer():
-            begin = meta_ref[0, d, 0]
-            end = meta_ref[0, d, 1]
-            fsp = meta_ref[0, d, 2]
-            m = meta_ref[0, d, 3]
-            regs_s[3] = regs_s[3] + jnp.where(m > 0, 1, 0)
-            # stage chars (DP band loads) and char*256+weight (SMEM
-            # mirror for the merge) once per layer
-            chars_v[0:1, 0:lp] = seqs_ref[0, pl.ds(d, 1), :]
-            chw_v[0:1, 0:lp] = chars_v[0:1, 0:lp] * 256 \
-                + wts_ref[0, pl.ds(d, 1), :]
+            # per-window layer metadata (meta rows exist for every
+            # d < d1, so reads past a window's own nlay are safe and
+            # their uses are act-gated)
+            begin_u = [meta_ref[u, d, 0] for u in range(_S)]
+            end_u = [meta_ref[u, d, 1] for u in range(_S)]
+            fsp_u = [meta_ref[u, d, 2] for u in range(_S)]
+            m_u = [meta_ref[u, d, 3] for u in range(_S)]
+            for u in range(_S):
+                regs_u[u][3] = regs_u[u][3] + jnp.where(
+                    act_u[u] & (m_u[u] > 0), 1, 0)
+                # stage chars (DP band loads) and char*256+weight
+                # (SMEM mirror for the merge) once per layer
+                chars_v[u:u + 1, 0:lp] = seqs_ref[u, pl.ds(d, 1), :]
+                chw_v[u:u + 1, 0:lp] = chars_v[u:u + 1, 0:lp] * 256 \
+                    + wts_ref[u, pl.ds(d, 1), :]
             stage_chw()
 
-            # 1+2) fused walk + banded DP: ONE pass over the topo list
-            # computes each in-subset node's row AND folds the sink
-            # scores inline.  Band placement is ANCHOR-based -- a
-            # node's expected query column scales with its backbone
-            # anchor -- so no pre-walk is needed to count subset ranks
-            # (the former separate walk cost ~0.24 us per listed node,
-            # ~25% of the kernel).  Anchors are non-decreasing along
-            # edges, so a predecessor's band never leads its
-            # successor's, preserving the dq >= 0 invariant the
-            # rank-based placement had.
-            end_eff = jnp.where(fsp > 0, _INF32 - 1, end)
-            smax_q = (jnp.maximum(m + 1 - wb, 0) + q - 1) // q
-            span = jnp.maximum(end - begin, 1)
+            # 1+2) fused walk + banded DP: ONE joint pass over both
+            # windows' topo lists; each joint iteration runs one rank
+            # of each window so the two serial score chains interleave
+            # in a single straight-line region (the whole point of
+            # pairing, see module docstring).  Band placement is
+            # rank-based from the carried in-subset counter: sq is
+            # monotone along the topo list, so a successor's band
+            # never lags any predecessor's (the dq >= 0 invariant).
+            end_eff_u = [jnp.where(fsp_u[u] > 0, _INF32 - 1, end_u[u])
+                         for u in range(_S)]
+            smax_u = [(jnp.maximum(m_u[u] + 1 - wb, 0) + q - 1) // q
+                      for u in range(_S)]
             # q8 fixed-point band slope per subset rank: nr is the
             # list length for full-span layers (their subset is the
             # whole graph) and a backbone-density estimate for partial
             # layers; one multiply+shift per rank replaces a dynamic
             # divide (nvis <= v, slope < 2^18 only when nr_est is 1
             # and m is at cap -- products stay inside int32)
-            nr_est = jnp.where(
-                fsp > 0, regs_s[2],
-                jnp.maximum(1, (span * regs_s[2]) // bblm))
-            slope_q8 = (m * 256) // jnp.maximum(nr_est, 1)
-            regs_s[6] = jnp.int32(-1)          # best sink node
-            regs_s[7] = jnp.int32(-_BIG)       # best sink score
+            slope_u = []
+            for u in range(_S):
+                span = jnp.maximum(end_u[u] - begin_u[u], 1)
+                nr_est = jnp.where(
+                    fsp_u[u] > 0, regs_u[u][2],
+                    jnp.maximum(1, (span * regs_u[u][2])
+                                // bblm_u[u]))
+                slope_u.append((m_u[u] * 256)
+                               // jnp.maximum(nr_est, 1))
+                regs_u[u][6] = jnp.int32(-1)    # best sink node
+                # sink-score floor: packed--inf rows unpack to -2^24,
+                # so the init must sit ABOVE that (else a sink whose
+                # end column only ever received propagated -inf would
+                # win the fold and the no-reachable-sink reject below
+                # could never fire) yet below any real score
+                # (|score| <= max|param| * (v + lp) << 2^22)
+                regs_u[u][7] = jnp.int32(-(1 << 22))
 
-            def slot_meta(pid, cnt, t):
+            def slot_meta(u, pid, cnt, t):
                 """(epoch-valid, band-start) for one pred slot."""
-                be = bandq_s[jnp.clip(pid, 0, v - 1)]
+                be = bandq_u[u][jnp.clip(pid, 0, v - 1)]
                 valid = (t < cnt) & (pid >= 0) & ((be >> 8) == d)
                 return valid, jnp.where(valid, be & 255, 0)
 
-            def pred_fold(pid, valid, sqp, sq_r):
+            def pred_fold(u, row, pid, valid, sqp, sq_r):
                 """One predecessor's H row realigned to this rank's
                 band, in vert space (u[c] = H_pred[s_r + c]); the diag
                 view is u shifted by one, applied once per rank after
                 the fold since the shift commutes with the max.
 
-                The row is staged into stage_v[0, :wb] and re-read at
+                The row is staged into the window's stage ref and re-read at
                 lane offset dq*q (128-aligned, so the dynamic slice is
                 free); the staging tail stays -inf, covering the
-                shifted window's overhang.  One store + one load + one
-                select replaces the former pad + N_SHIFT selects."""
+                shifted window's overhang."""
                 dq = sq_r - sqp
                 ok = valid & (dq >= 0) & (dq < _N_SHIFT)
                 dqc = jnp.clip(dq, 0, _N_SHIFT - 1)
-                stage_v[0:1, 0:wb] = ring_v[pl.ds(jnp.maximum(pid, 0),
-                                                  1), :]
-                hv = stage_v[0:1, pl.ds(pl.multiple_of(dqc * q, q),
-                                        wb)]
+                stage_u[u][row:row + 1, 0:wb] = ring_u[u][
+                    pl.ds(jnp.clip(pid, 0, v - 1), 1), :]
+                hvp = stage_u[u][row:row + 1,
+                                 pl.ds(pl.multiple_of(dqc * q, q),
+                                       wb)]
+                # unpack the score (arithmetic >> 6 floors negatives
+                # correctly since the packed code is non-negative)
+                hv = (hvp >> 6).astype(jnp.float32)
                 hv = jnp.where(ok, hv, negf)
                 # a predecessor whose band lags out of shift range
                 # cannot contribute; silently degrading would corrupt
@@ -454,21 +542,128 @@ def _kernel(nlay_ref, bblen_ref,
                 bad = valid & jnp.logical_not(ok)
                 return hv, jnp.where(valid, 1, 0), bad
 
-            def acc_update(hv, t):
-                a0 = accs[0:1, :]
+            def acc_update(u, hv, t):
+                a0 = accs_u[u][0:1, :]
                 up = hv > a0
-                accs[0:1, :] = jnp.where(up, hv, a0)
-                arga[0:1, :] = jnp.where(up, t, arga[0:1, :])
+                accs_u[u][0:1, :] = jnp.where(up, hv, a0)
+                arga_u[u][0:1, :] = jnp.where(up, t, arga_u[u][0:1, :])
 
-            def epilogue(node, s_r, accu, argu):
-                """Row finish shared by both in-degree branches: sub
-                scores, the three-way move max, the in-row gap chain,
-                direction codes, stores."""
-                # this band's seq chars: one 128-aligned window load;
-                # chars past the layer length are 0 pads and never
-                # equal a real base, so no explicit j < m mask
-                sb = chars_v[0:1, pl.ds(pl.multiple_of(s_r, q), wb)]
-                sub_u = jnp.where(sb == base_s[node], matchf,
+            def dp_pre(u, node, nvis):
+                """Scalar prolog + first-slot fold for one rank of
+                window u; node -1 = walk done (inert).  Pure compute
+                with clamped indices (garbage-safe): the two windows'
+                prologs run back to back in one basic block."""
+                live = node >= 0
+                nodec = jnp.maximum(node, 0)
+                anc = anch_u[u][nodec]
+                in_sub = live & act_u[u] & (
+                    (fsp_u[u] > 0) |
+                    ((anc >= begin_u[u]) & (anc <= end_u[u])))
+                cnt = pcnt_u[u][nodec]
+                # subset SINKS snap to the last quantum: their row is
+                # only ever read at column m - s_r (the inline sink
+                # fold below), and the floor-quantized interpolation
+                # can misplace by up to q-1 columns, which at narrow
+                # bands would push the end column out of reach
+                is_sink_n = minsucc_u[u][nodec] > end_eff_u[u]
+                sq_r = jnp.where(
+                    is_sink_n, smax_u[u],
+                    jnp.clip(
+                        (((nvis * slope_u[u]) >> 8) - (q // 2)) >> 7,
+                        0, smax_u[u]))
+                s_r = sq_r * q
+                pid0 = jnp.where(cnt > 0, predsm_u[u][(nodec) * 8 + 0],
+                                 -1)
+                val0, sqp0 = slot_meta(u, pid0, cnt, 0)
+                pid1 = predsm_u[u][(nodec) * 8 + 1]
+                val1, sqp1 = slot_meta(u, pid1, cnt, 1)
+                pid2 = predsm_u[u][(nodec) * 8 + 2]
+                val2, sqp2 = slot_meta(u, pid2, cnt, 2)
+                pid3 = predsm_u[u][(nodec) * 8 + 3]
+                val3, sqp3 = slot_meta(u, pid3, cnt, 3)
+                vvb = s_r.astype(jnp.float32) * gapf
+
+                hv0, nv0, bad0 = pred_fold(u, 0, pid0, val0, sqp0,
+                                           sq_r)
+                hv1, nv1, bad1 = pred_fold(u, 1, pid1, val1, sqp1,
+                                           sq_r)
+                hv2, nv2, bad2 = pred_fold(u, 2, pid2, val2, sqp2,
+                                           sq_r)
+                hv3, nv3, bad3 = pred_fold(u, 3, pid3, val3, sqp3,
+                                           sq_r)
+                # first-slot-wins argmax tree (matches the former
+                # sequential strict-> update order exactly)
+                a01 = jnp.maximum(hv0, hv1)
+                g01 = jnp.where(hv1 > hv0, 1, 0)
+                a23 = jnp.maximum(hv2, hv3)
+                g23 = jnp.where(hv3 > hv2, 3, 2)
+                accf = jnp.maximum(a01, a23)
+                argf = jnp.where(a23 > a01, g23, g01)
+                return dict(node=node, nvis=nvis, live=live,
+                            nodec=nodec, in_sub=in_sub, cnt=cnt,
+                            is_sink_n=is_sink_n, sq_r=sq_r, s_r=s_r,
+                            vvb=vvb, accf=accf, argf=argf,
+                            nv03=nv0 + nv1 + nv2 + nv3,
+                            nbad03=(jnp.where(bad0, 1, 0)
+                                    + jnp.where(bad1, 1, 0)
+                                    + jnp.where(bad2, 1, 0)
+                                    + jnp.where(bad3, 1, 0)),
+                            deep=cnt > 4,
+                            nxt=jnp.where(live & act_u[u],
+                                          nxt_u[u][nodec], -1),
+                            nvis2=nvis + jnp.where(in_sub, 1, 0))
+
+            def dp_deep(u, st):
+                """Slots 4+ fold (rare: in-degree > 4), in its own
+                act-gated region; folds on top of the slot 0-3 tree
+                into accs/arga + regs 8."""
+                in_sub, deep_c = st["in_sub"], st["deep"]
+                nodec, cnt = st["nodec"], st["cnt"]
+                sq_r = st["sq_r"]
+
+                @pl.when(in_sub & deep_c)
+                def _():
+                    regs_u[u][8] = jnp.int32(0)   # nreal slots 4+
+                    accs_u[u][0:1, :] = st["accf"]
+                    arga_u[u][0:1, :] = st["argf"]
+                    prow = vload(preds_u[u], nodec)
+
+                    def deep_step(t, nr2):
+                        pid = e11(jnp.sum(
+                            jnp.where(iota_p == t, prow, 0),
+                            axis=1, keepdims=True))
+                        val, sqp = slot_meta(u, pid, cnt, t)
+                        hv, nv, bad = pred_fold(u, 0, pid, val, sqp,
+                                                sq_r)
+                        acc_update(u, hv, t)
+
+                        @pl.when(bad)
+                        def _():
+                            regs_u[u][0] = jnp.int32(FAIL_KCAP)
+                        return nr2 + nv
+
+                    regs_u[u][8] = lax.fori_loop(4, cnt, deep_step,
+                                                 jnp.int32(0))
+
+            def dp_epi(u, st):
+                """Pure epilogue: the serial gap-chain.  Both windows'
+                epilogues are emitted back to back with no region
+                boundary between them, so the VLIW scheduler can fill
+                one chain's latency stalls with the other's ops."""
+                nodec, deep_c, vvb = st["nodec"], st["deep"], st["vvb"]
+                s_r = st["s_r"]
+                nreal = st["nv03"] + jnp.where(deep_c, regs_u[u][8], 0)
+                nbad = st["nbad03"]
+                novel = nreal == 0
+                accu = jnp.where(novel, colsg + vvb,
+                                 jnp.where(deep_c, accs_u[u][0:1, :],
+                                           st["accf"]))
+                argu = jnp.where(novel, 0,
+                                 jnp.where(deep_c, arga_u[u][0:1, :],
+                                           st["argf"]))
+                sb = chars_v[u:u + 1, pl.ds(pl.multiple_of(s_r, q),
+                                            wb)]
+                sub_u = jnp.where(sb == base_u[u][nodec], matchf,
                                   mismatchf)
                 dmax_u = accu + sub_u
                 vmax = accu + gapf
@@ -489,217 +684,218 @@ def _kernel(nlay_ref, bblen_ref,
                     dmax == hr, argd,
                     jnp.where(vmax == hr, argu + p,
                               2 * p)).astype(jnp.int32)
-                dirs[pl.ds(node, 1), :] = code
-                ring_v[pl.ds(node, 1), :] = hr
+                # pack score and direction code into ONE row (halves
+                # the dominant VMEM scratch and saves a store): codes
+                # are < 2p+1 <= 33 < 64, scores are exact ints well
+                # under 2^24 (|score| <= |gap|*(v+lp)); -inf clamps to
+                # -2^24, still far below any reachable score
+                hpk = (jnp.clip(hr, -float(1 << 24),
+                                float(1 << 24)).astype(jnp.int32)
+                       * 64 + code)
+                return hr, hpk, nbad
 
-            def dp_cond(c):
-                return c[0] >= 0
-
-            def dp_body(c):
-                node, nvis = c
-                anc = anch_s[node]
-                in_sub = (fsp > 0) | ((anc >= begin) & (anc <= end))
+            def dp_store(u, st, hr, hpk, nbad):
+                """Gated stores + sink fold for one rank."""
+                in_sub, nodec = st["in_sub"], st["nodec"]
+                sq_r, s_r = st["sq_r"], st["s_r"]
 
                 @pl.when(in_sub)
                 def _():
-                    cnt = pcnt_s[node]
-                    # rank-based band placement from the carried
-                    # in-subset counter: sq is monotone along the topo
-                    # list, so a successor's band never lags any
-                    # predecessor's (the dq >= 0 invariant), exactly
-                    # like the pre-fusion two-pass design.  Subset
-                    # SINKS snap to the last quantum: their row is
-                    # only ever read at column m - s_r (the inline
-                    # sink fold below), and the floor-quantized
-                    # interpolation can misplace by up to q-1 columns,
-                    # which at narrow bands (-b, wb == q) would push
-                    # the end column out of every sink's band and fail
-                    # the window
-                    is_sink_n = minsucc_s[node] > end_eff
-                    sq_r = jnp.where(
-                        is_sink_n, smax_q,
-                        jnp.clip(
-                            (((nvis * slope_q8) >> 8) - (q // 2)) >> 7,
-                            0, smax_q))
-                    s_r = sq_r * q
-                    pid0 = jnp.where(cnt > 0, predsm_s[node * 8], -1)
-                    val0, sqp0 = slot_meta(pid0, cnt, 0)
-                    pid1 = predsm_s[node * 8 + 1]
-                    val1, sqp1 = slot_meta(pid1, cnt, 1)
-                    pid2 = predsm_s[node * 8 + 2]
-                    val2, sqp2 = slot_meta(pid2, cnt, 2)
-                    pid3 = predsm_s[node * 8 + 3]
-                    val3, sqp3 = slot_meta(pid3, cnt, 3)
-                    vvb = s_r.astype(jnp.float32) * gapf
+                    ring_u[u][pl.ds(nodec, 1), :] = hpk
+                    bandq_u[u][nodec] = (d << 8) | sq_r
 
-                    regs_s[8] = jnp.int32(0)   # nreal slots 1+
-                    regs_s[9] = jnp.int32(0)   # nbad slots 1+
-                    hv0, nv0, bad0 = pred_fold(pid0, val0, sqp0, sq_r)
-
-                    @pl.when(cnt > 1)
+                    @pl.when(nbad > 0)
                     def _():
-                        accs[0:1, :] = hv0
-                        arga[0:1, :] = jnp.zeros((1, wb), jnp.int32)
-                        for t, (pid, val, sqp) in (
-                                (1, (pid1, val1, sqp1)),
-                                (2, (pid2, val2, sqp2)),
-                                (3, (pid3, val3, sqp3))):
-                            @pl.when(cnt > t)
-                            def _(t=t, pid=pid, val=val, sqp=sqp):
-                                hv, nv, bad = pred_fold(pid, val, sqp,
-                                                        sq_r)
-                                acc_update(hv, t)
-                                regs_s[8] = regs_s[8] + nv
-                                regs_s[9] = regs_s[9] + \
-                                    jnp.where(bad, 1, 0)
-
-                        @pl.when(cnt > 4)
-                        def _deep():
-                            prow = vload(preds_v, node)
-
-                            def deep_step(t, nr2):
-                                pid = e11(jnp.sum(
-                                    jnp.where(iota_p == t, prow, 0),
-                                    axis=1, keepdims=True))
-                                val, sqp = slot_meta(pid, cnt, t)
-                                hv, nv, bad = pred_fold(pid, val, sqp,
-                                                        sq_r)
-                                acc_update(hv, t)
-
-                                @pl.when(bad)
-                                def _():
-                                    regs_s[0] = jnp.int32(FAIL_KCAP)
-                                return nr2 + nv
-
-                            regs_s[8] = regs_s[8] + lax.fori_loop(
-                                4, cnt, deep_step, jnp.int32(0))
-
-                    nreal = nv0 + regs_s[8]
-
-                    @pl.when((jnp.where(bad0, 1, 0) + regs_s[9]) > 0)
-                    def _():
-                        regs_s[0] = jnp.int32(FAIL_KCAP)
-
-                    novel = nreal == 0
-                    multi = cnt > 1
-                    accu = jnp.where(novel, colsg + vvb,
-                                     jnp.where(multi, accs[0:1, :],
-                                               hv0))
-                    argu = jnp.where(novel | jnp.logical_not(multi),
-                                     0, arga[0:1, :])
-                    epilogue(node, s_r, accu, argu)
-
-                    bandq_s[node] = (d << 8) | sq_r
+                        regs_u[u][0] = jnp.int32(FAIL_KCAP)
 
                     # inline sink fold: only true subset sinks pay the
                     # vector->scalar score extraction
-                    @pl.when(minsucc_s[node] > end_eff)
+                    @pl.when(st["is_sink_n"])
                     def _sink():
-                        c_end = m - s_r
+                        c_end = m_u[u] - s_r
 
                         @pl.when(c_end < wb)
                         def _():
-                            hrow = ring_v[pl.ds(node, 1), :]
                             ccl = jnp.clip(c_end, 0, wb - 1)
                             s_end = jnp.sum(jnp.where(
-                                cols_i == ccl, hrow,
+                                cols_i == ccl, hr,
                                 jnp.float32(0))).astype(jnp.int32)
 
-                            @pl.when(s_end > regs_s[7])
+                            @pl.when(s_end > regs_u[u][7])
                             def _():
-                                regs_s[7] = s_end
-                                regs_s[6] = node
-                return nxt_s[node], nvis + jnp.where(in_sub, 1, 0)
+                                regs_u[u][7] = s_end
+                                regs_u[u][6] = st["node"]
 
-            _, nvis = lax.while_loop(dp_cond, dp_body,
-                                     (regs_s[1], jnp.int32(0)))
-            regs_s[4] = regs_s[4] + nvis
-            best_node = regs_s[6]
+            def dp_cond(c):
+                return (c[0] >= 0) | (c[2] >= 0)
 
-            # no subset sink landed within band reach of the layer
-            # end (the nr estimate misplaced the bands): tracing back
-            # from node -1 would fabricate an all-new path, so the
-            # window must fail to the CPU engine instead
-            @pl.when((best_node < 0) & (nvis > 0))
-            def _():
-                regs_s[0] = jnp.int32(FAIL_KCAP)
+            def dp_body(c):
+                n0, v0, n1, v1 = c
+                st0 = dp_pre(0, n0, v0)
+                st1 = dp_pre(1, n1, v1)
+                dp_deep(0, st0)
+                dp_deep(1, st1)
+                e0 = dp_epi(0, st0)
+                e1 = dp_epi(1, st1)
+                dp_store(0, st0, *e0)
+                dp_store(1, st1, *e1)
+                return st0["nxt"], st0["nvis2"], st1["nxt"], \
+                    st1["nvis2"]
 
+            head_u = [jnp.where(act_u[u], regs_u[u][1], -1)
+                      for u in range(_S)]
+            _, nvis0, _, nvis1 = lax.while_loop(
+                dp_cond, dp_body,
+                (head_u[0], jnp.int32(0), head_u[1], jnp.int32(0)))
+            nvis_u = [nvis0, nvis1]
+            for u in range(_S):
+                regs_u[u][4] = regs_u[u][4] + nvis_u[u]
+
+                # no subset sink landed within band reach of the
+                # layer end: tracing back from node -1 would fabricate
+                # an all-new path, so the window must fail to the CPU
+                # engine instead
+                @pl.when(act_u[u] & (regs_u[u][6] < 0) &
+                         (nvis_u[u] > 0))
+                def _(u=u):
+                    regs_u[u][0] = jnp.int32(FAIL_KCAP)
 
             # 3) traceback -> reversed path in path_s, packed as
             # (node+2)*pkr + (spos+2); node -1 = no node (horiz),
-            # carried node -1 = virtual start row
-            def tb_cond(c):
-                node, j, step = c
-                return ((node >= 0) | (j > 0)) & (step < tape)
+            # carried node -1 = virtual start row.  Joint loop: both
+            # windows' steps interleave so the per-step extract
+            # latencies overlap.
+            tact_u = [act_u[u] & (regs_u[u][0] == 0)
+                      for u in range(_S)]
 
-            def tb_body(c):
-                node, j, step = c
+            def tb_pre(u, node, jj, step, live):
+                """Pure step compute (incl. the per-step direction
+                extract, the latency to hide); both windows' pres run
+                in one block."""
                 nodec = jnp.maximum(node, 0)
-                be = bandq_s[nodec]
+                be = bandq_u[u][nodec]
                 s0 = jnp.where(node >= 0, be & 255, 0) * q
-                cc = jnp.clip(j - s0, 0, wb - 1)
-                drow = dirs[pl.ds(nodec, 1), :]
-                code = jnp.sum(jnp.where(cols_i == cc, drow, 0))
+                cc = jnp.clip(jj - s0, 0, wb - 1)
+                drow = ring_u[u][pl.ds(nodec, 1), :]
+                code = jnp.sum(jnp.where(cols_i == cc, drow, 0)) % 64
                 is_diag = (code < p) & (node >= 0)
                 is_vert = (code >= p) & (code < 2 * p) & (node >= 0)
                 take = is_diag | is_vert
                 slot = jnp.clip(jnp.where(is_diag, code, code - p),
                                 0, p - 1)
+                pidm = predsm_u[u][(nodec) * 8
+                                   + jnp.clip(slot, 0, 7)]
+                return dict(node=node, jj=jj, step=step, live=live,
+                            nodec=nodec, take=take, is_vert=is_vert,
+                            slot=slot, pidm=pidm)
 
-                def mirror(_):
-                    return predsm_s[nodec * 8 + jnp.clip(slot, 0, 7)]
+            def tb_fin(u, st):
+                node, jj, step = st["node"], st["jj"], st["step"]
+                live, nodec = st["live"], st["nodec"]
+                take, is_vert = st["take"], st["is_vert"]
+                slot = st["slot"]
 
                 def deep(_):
-                    prow = vload(preds_v, nodec)
+                    prow = vload(preds_u[u], nodec)
                     return jnp.sum(jnp.where(iota_p == slot, prow, 0))
 
-                pid = lax.cond(slot < 8, mirror, deep, 0)
+                def keep(_):
+                    return st["pidm"]
+
+                pid = lax.cond(slot >= 8, deep, keep, 0)
                 pvalid = (pid >= 0) & \
-                    ((bandq_s[jnp.clip(pid, 0, v - 1)] >> 8) == d)
+                    ((bandq_u[u][jnp.clip(pid, 0, v - 1)] >> 8)
+                     == d)
                 pnode = jnp.where(pvalid, pid, -1)
                 en = jnp.where(take, node, -1)
-                es = jnp.where(is_vert, -1, j - 1)
-                path_s[step] = (en + 2) * pkr + (es + 2)
-                nn = jnp.where(take, pnode, node)
-                nj = jnp.where(is_vert, j, jnp.maximum(j - 1, 0))
-                return nn, nj, step + 1
+                es = jnp.where(is_vert, -1, jj - 1)
 
-            _, _, plen = lax.while_loop(
-                tb_cond, tb_body, (best_node, m, jnp.int32(0)))
+                @pl.when(live)
+                def _():
+                    path_u[u][jnp.clip(step, 0, tape - 1)] = \
+                        (en + 2) * pkr + (es + 2)
+                nn2 = jnp.where(take, pnode, node)
+                nj = jnp.where(is_vert, jj, jnp.maximum(jj - 1, 0))
+                return (jnp.where(live, nn2, node),
+                        jnp.where(live, nj, jj),
+                        step + jnp.where(live, 1, 0))
 
-            @pl.when(plen >= tape)
-            def _():
-                regs_s[0] = jnp.int32(FAIL_PATH)
+            def tb_cond(c):
+                n0, j0, s0c, n1, j1, s1c = c
+                live0 = ((n0 >= 0) | (j0 > 0)) & (s0c < tape)
+                live1 = ((n1 >= 0) | (j1 > 0)) & (s1c < tape)
+                return live0 | live1
+
+            def tb_body(c):
+                n0, j0, s0c, n1, j1, s1c = c
+                live0 = ((n0 >= 0) | (j0 > 0)) & (s0c < tape)
+                live1 = ((n1 >= 0) | (j1 > 0)) & (s1c < tape)
+                st0 = tb_pre(0, n0, j0, s0c, live0)
+                st1 = tb_pre(1, n1, j1, s1c, live1)
+                n0, j0, s0c = tb_fin(0, st0)
+                n1, j1, s1c = tb_fin(1, st1)
+                return n0, j0, s0c, n1, j1, s1c
+
+            tb0 = [jnp.where(tact_u[u], regs_u[u][6], -1)
+                   for u in range(_S)]
+            tbm = [jnp.where(tact_u[u], m_u[u], 0) for u in range(_S)]
+            _, _, plen0, _, _, plen1 = lax.while_loop(
+                tb_cond, tb_body,
+                (tb0[0], tbm[0], jnp.int32(0),
+                 tb0[1], tbm[1], jnp.int32(0)))
+            plen_u = [plen0, plen1]
+            for u in range(_S):
+                @pl.when(tact_u[u] & (plen_u[u] >= tape))
+                def _(u=u):
+                    regs_u[u][0] = jnp.int32(FAIL_PATH)
 
             # 4) merge (poa_graph.hpp add_alignment), walking the
             # reversed path backward = forward order; chars/weights
-            # come from the row staged at layer start
-            def merge(t, carry):
-                # flattened per-step control flow: the dominant case
-                # (match into an existing same-base node) runs with
-                # ONE vector->scalar sync (the char extraction) and
-                # no lax.cond; rare cases (insertion, mismatch into
-                # an aligned group) sit behind one pl.when
-                prev, prev_w = carry
-                packed = path_s[plen - 1 - t]
+            # come from the rows staged at layer start.  Joint loop:
+            # the two windows' scalar chase chains interleave.
+            mact_u = [act_u[u] & (regs_u[u][0] == 0)
+                      for u in range(_S)]
+            mlen_u = [jnp.where(mact_u[u], plen_u[u], 0)
+                      for u in range(_S)]
+
+            def m_pre(u, t, prev, prev_w):
+                """Pure step decode (the scalar chase chain); both
+                windows' pres run in one block."""
+                act = t < mlen_u[u]
+                packed = path_u[u][jnp.clip(mlen_u[u] - 1 - t, 0,
+                                            tape - 1)]
                 nid = packed // pkr - 2
-                j = packed % pkr - 2
-                has = j >= 0
-                c, w = chw_at(jnp.maximum(j, 0))
+                jj = packed % pkr - 2
+                has = act & (jj >= 0)
+                # clamp to the staged row: an inactive lane decodes a
+                # garbage path slot, and OOB SMEM reads are UB even
+                # when the result is masked out
+                c, w = chw_at(u, jnp.clip(jj, 0, lp - 1))
                 fast = has & (nid >= 0) & \
-                    (base_s[jnp.maximum(nid, 0)] == c)
-                regs_s[10] = nid        # resolved target (fast case)
+                    (base_u[u][jnp.clip(nid, 0, v - 1)] == c)
+                return dict(prev=prev, prev_w=prev_w, nid=nid,
+                            has=has, c=c, w=w, fast=fast)
+
+            def m_apply(u, st):
+                # flattened per-step control flow: the dominant case
+                # (match into an existing same-base node) runs with no
+                # lax.cond; rare cases (insertion, mismatch into an
+                # aligned group) sit behind one pl.when
+                prev, prev_w = st["prev"], st["prev_w"]
+                nid, has = st["nid"], st["has"]
+                c, w, fast = st["c"], st["w"], st["fast"]
+                regs_u[u][10] = nid  # resolved target (fast case)
 
                 @pl.when(has & jnp.logical_not(fast))
                 def _slow():
                     def t_new(_):
                         anchor = jnp.where(
-                            prev < 0, begin,
-                            anch_s[jnp.maximum(prev, 0)])
+                            prev < 0, begin_u[u],
+                            anch_u[u][jnp.maximum(prev, 0)])
                         pos = jnp.where(
                             prev < 0, -1,
-                            glast_s[jnp.maximum(prev, 0)])
-                        return new_node(c, anchor, pos)
+                            glast_u[u][jnp.maximum(prev, 0)])
+                        return new_node(u, c, anchor, pos)
 
                     def t_aligned(_):
                         # mismatch: reuse an aligned sibling with the
@@ -710,198 +906,221 @@ def _kernel(nlay_ref, bblen_ref,
                         # vector compare + extract, and group members
                         # have distinct bases by construction so at
                         # most one entry matches
-                        gc = gcnt_s[nid]
-                        arow = vload(aligsm_v, nid)
+                        gc = gcnt_u[u][nid]
+                        arow = vload(aligsm_u[u], nid)
                         h = e11(jnp.min(jnp.where(
                             (arow % 256 == c) & (iota_a < gc),
                             arow // 256, v), axis=1, keepdims=True))
                         found = jnp.where(h < v, h, -1)
 
                         def mk_new(_):
-                            tgt = new_node(c, anch_s[nid],
-                                           glast_s[nid])
+                            tgt = new_node(u, c, anch_u[u][nid],
+                                           glast_u[u][nid])
 
                             @pl.when(gc >= a_)
                             def _():
-                                regs_s[0] = jnp.int32(FAIL_ALIGNED)
+                                regs_u[u][0] = \
+                                    jnp.int32(FAIL_ALIGNED)
 
                             @pl.when(gc < a_)
                             def _():
                                 # tgt's group = nid's members + nid
-                                nb = base_s[nid]
-                                aligsm_v[pl.ds(tgt, 1), :] = jnp.where(
-                                    iota_a == gc, nid * 256 + nb, arow)
-                                gcnt_s[tgt] = gc + 1
+                                nb = base_u[u][nid]
+                                aligsm_u[u][pl.ds(tgt, 1), :] = \
+                                    jnp.where(iota_a == gc,
+                                              nid * 256 + nb, arow)
+                                gcnt_u[u][tgt] = gc + 1
 
                                 # append tgt to each member (groups
-                                # already full skip the append, like
-                                # the full-row no-op store before)
+                                # already full skip the append)
                                 def ap(aa, _):
                                     sib = e11(jnp.sum(jnp.where(
-                                        iota_a == aa, arow, 0), axis=1,
-                                        keepdims=True)) // 256
-                                    gs = gcnt_s[sib]
+                                        iota_a == aa, arow, 0),
+                                        axis=1, keepdims=True)) // 256
+                                    gs = gcnt_u[u][sib]
 
                                     @pl.when(gs < a_)
                                     def _():
-                                        srow_a = vload(aligsm_v, sib)
-                                        aligsm_v[pl.ds(sib, 1), :] = \
-                                            jnp.where(iota_a == gs,
-                                                      tgt * 256 + c,
-                                                      srow_a)
-                                        gcnt_s[sib] = gs + 1
-                                    glast_s[sib] = tgt
+                                        srw = vload(aligsm_u[u], sib)
+                                        aligsm_u[u][
+                                            pl.ds(sib, 1),
+                                            :] = jnp.where(
+                                                iota_a == gs,
+                                                tgt * 256 + c, srw)
+                                        gcnt_u[u][sib] = gs + 1
+                                    glast_u[u][sib] = tgt
                                     return 0
 
                                 lax.fori_loop(0, gc, ap, 0)
-                                aligsm_v[pl.ds(nid, 1), :] = jnp.where(
-                                    iota_a == gc, tgt * 256 + c, arow)
-                                gcnt_s[nid] = gc + 1
-                                glast_s[nid] = tgt
+                                aligsm_u[u][pl.ds(nid, 1), :] = \
+                                    jnp.where(iota_a == gc,
+                                              tgt * 256 + c, arow)
+                                gcnt_u[u][nid] = gc + 1
+                                glast_u[u][nid] = tgt
                             return tgt
 
                         return lax.cond(found >= 0, lambda _: found,
                                         mk_new, 0)
 
-                    regs_s[10] = lax.cond(nid < 0, t_new, t_aligned, 0)
+                    regs_u[u][10] = lax.cond(nid < 0, t_new,
+                                                t_aligned, 0)
 
-                target = regs_s[10]
+                target = regs_u[u][10]
 
                 @pl.when(has)
                 def _():
-                    nseq_s[target] = nseq_s[target] + 1
+                    nseq_u[u][target] = nseq_u[u][target] + 1
 
                     @pl.when(prev >= 0)
                     def _():
-                        add_edge(prev, target, prev_w + w)
+                        add_edge(u, prev, target, prev_w + w)
 
                 return (jnp.where(has, target, prev),
                         jnp.where(has, w, prev_w))
 
-            lax.fori_loop(0, plen, merge,
-                          (jnp.int32(-1), jnp.int32(0)))
+            def mbody(t, carry):
+                p0, w0, p1, w1 = carry
+                st0 = m_pre(0, t, p0, w0)
+                st1 = m_pre(1, t, p1, w1)
+                p0, w0 = m_apply(0, st0)
+                p1, w1 = m_apply(1, st1)
+                return p0, w0, p1, w1
+
+            lax.fori_loop(0, jnp.maximum(mlen_u[0], mlen_u[1]), mbody,
+                          (jnp.int32(-1), jnp.int32(0),
+                           jnp.int32(-1), jnp.int32(0)))
         return 0
 
-    lax.fori_loop(1, nlay + 1, layer, 0)
+    lax.fori_loop(1, jnp.maximum(nlay_u[0], nlay_u[1]) + 1, layer, 0)
 
-    # ---- consensus: heaviest bundle over the full graph -------------
-    fail = regs_s[0]
+    # ---- consensus: heaviest bundle over each full graph ------------
+    for u in range(_S):
+        fail = regs_u[u][0]
+        for r in range(8):
+            mout_ref[u, r, 0] = jnp.int32(0)
+        mout_ref[u, 0, 0] = jnp.where(fail == 0, 0, -1)
+        mout_ref[u, 2, 0] = fail
+        mout_ref[u, 3, 0] = regs_u[u][2]
+        mout_ref[u, 4, 0] = regs_u[u][4]
 
-    mout_ref[0, :, :] = jnp.zeros((8, 1), jnp.int32)
-    mout_ref[0, 0:1, 0:1] = jnp.full((1, 1),
-                                     jnp.where(fail == 0, 0, -1),
-                                     jnp.int32)
-    mout_ref[0, 2:3, 0:1] = jnp.full((1, 1), fail, jnp.int32)
-    mout_ref[0, 3:4, 0:1] = jnp.full((1, 1), regs_s[2], jnp.int32)
-    mout_ref[0, 4:5, 0:1] = jnp.full((1, 1), regs_s[4], jnp.int32)
+        @pl.when(fail == 0)
+        def _consensus(u=u):
+            # walk the list once for a full topo order
+            def wcond(c):
+                return c[0] >= 0
 
-    @pl.when(fail == 0)
-    def _consensus():
-        # walk the list once for a full topo order
-        def wcond(c):
-            return c[0] >= 0
+            def wbody(c):
+                node, r = c
+                order_u[u][r] = node
+                return nxt_u[u][node], r + 1
 
-        def wbody(c):
-            node, r = c
-            order_s[r] = node
-            return nxt_s[node], r + 1
+            _, n_all = lax.while_loop(wcond, wbody,
+                                      (regs_u[u][1], jnp.int32(0)))
 
-        _, n_all = lax.while_loop(wcond, wbody,
-                                  (regs_s[1], jnp.int32(0)))
+            # forward DP: per node pick the heaviest in-edge (ties ->
+            # higher predecessor score; slot order = insertion order,
+            # matching poa_graph.hpp consensus_path)
+            def cdp(r, best_sink):
+                node = order_u[u][r]
+                cnt = pcnt_u[u][node]
 
-        # forward DP: per node pick the heaviest in-edge (ties ->
-        # higher predecessor score; slot order = insertion order,
-        # matching poa_graph.hpp consensus_path).  Ids come from the
-        # SMEM mirror for the common <=4-pred case, weights from SMEM.
-        def cdp(r, best_sink):
-            node = order_s[r]
-            cnt = pcnt_s[node]
+                def pick(t, carry):
+                    bu, bw = carry
+                    pidm = predsm_u[u][(node) * 8 + 0
+                                    + jnp.clip(t, 0, 7)]
 
-            def pick(t, carry):
-                bu, bw = carry
+                    def deep(_):
+                        prow = vload(preds_u[u], node)
+                        return e11(jnp.sum(
+                            jnp.where(iota_p == t, prow, 0), axis=1,
+                            keepdims=True))
 
-                def mirror(_):
-                    return predsm_s[node * 8 + jnp.clip(t, 0, 7)]
+                    def keep(_):
+                        return pidm
 
-                def deep(_):
-                    prow = vload(preds_v, node)
-                    return e11(jnp.sum(
-                        jnp.where(iota_p == t, prow, 0), axis=1,
-                        keepdims=True))
+                    pid = lax.cond(t >= 8, deep, keep, 0)
+                    w = predw_u[u][(node) * p + 0 + t]
+                    sc = score_u[u][jnp.maximum(pid, 0)]
+                    bsc = score_u[u][jnp.maximum(bu, 0)]
+                    tk = (pid >= 0) & ((w > bw) |
+                                       ((w == bw) & (bu >= 0) &
+                                        (sc > bsc)))
+                    return (jnp.where(tk, pid, bu),
+                            jnp.where(tk, w, bw))
 
-                pid = lax.cond(t < 8, mirror, deep, 0)
-                w = predw_s[node * p + t]
-                sc = score_s[jnp.maximum(pid, 0)]
-                bsc = score_s[jnp.maximum(bu, 0)]
-                tk = (pid >= 0) & ((w > bw) |
-                                   ((w == bw) & (bu >= 0) &
-                                    (sc > bsc)))
-                return (jnp.where(tk, pid, bu), jnp.where(tk, w, bw))
+                best_u, best_w = lax.fori_loop(
+                    0, cnt, pick, (jnp.int32(-1), jnp.int32(-1)))
+                score_u[u][node] = jnp.where(
+                    best_u >= 0,
+                    score_u[u][jnp.maximum(best_u, 0)] + best_w, 0)
+                cpred_u[u][node] = best_u
+                is_sink = minsucc_u[u][node] >= _INF32
+                better = is_sink & (
+                    (best_sink < 0) |
+                    (score_u[u][node] >
+                     score_u[u][jnp.maximum(best_sink, 0)]))
+                return jnp.where(better, node, best_sink)
 
-            best_u, best_w = lax.fori_loop(
-                0, cnt, pick, (jnp.int32(-1), jnp.int32(-1)))
-            score_s[node] = jnp.where(
-                best_u >= 0,
-                score_s[jnp.maximum(best_u, 0)] + best_w, 0)
-            cpred_s[node] = best_u
-            is_sink = minsucc_s[node] >= _INF32
-            better = is_sink & (
-                (best_sink < 0) |
-                (score_s[node] > score_s[jnp.maximum(best_sink, 0)]))
-            return jnp.where(better, node, best_sink)
+            best_sink = lax.fori_loop(0, n_all, cdp, jnp.int32(-1))
 
-        best_sink = lax.fori_loop(0, n_all, cdp, jnp.int32(-1))
+            # backtrack (reversed), then emit forward
+            def bcond(c):
+                return c[0] >= 0
 
-        # backtrack into pthn_v (reversed), then emit forward
-        def bcond(c):
-            return c[0] >= 0
+            def bbody(c):
+                node, ln = c
+                path_u[u][ln] = (node + 2) * pkr + 2
+                return cpred_u[u][node], ln + 1
 
-        def bbody(c):
-            node, ln = c
-            path_s[ln] = (node + 2) * pkr + 2
-            return cpred_s[node], ln + 1
+            _, clen = lax.while_loop(bcond, bbody,
+                                     (best_sink, jnp.int32(0)))
 
-        _, clen = lax.while_loop(bcond, bbody,
-                                 (best_sink, jnp.int32(0)))
+            # TGS trim (rt_poab_consensus: threshold (n_seqs - 1) / 2)
+            avg = (regs_u[u][3] - 1) // 2
 
-        # TGS trim (rt_poab_consensus: threshold (n_seqs - 1) / 2)
-        avg = (regs_s[3] - 1) // 2
+            def scan_fwd(t, first):
+                node = path_u[u][clen - 1 - t] // pkr - 2
+                cov = nseq_u[u][node]
+                hit = (first < 0) & (cov >= avg)
+                return jnp.where(hit, t, first)
 
-        def scan_fwd(t, first):
-            node = path_s[clen - 1 - t] // pkr - 2   # forward pos t
-            cov = nseq_s[node]
-            hit = (first < 0) & (cov >= avg)
-            return jnp.where(hit, t, first)
+            def scan_bwd(t, last):
+                node = path_u[u][t] // pkr - 2
+                cov = nseq_u[u][node]
+                hit = (last < 0) & (cov >= avg)
+                return jnp.where(hit, clen - 1 - t, last)
 
-        def scan_bwd(t, last):
-            node = path_s[t] // pkr - 2
-            cov = nseq_s[node]
-            hit = (last < 0) & (cov >= avg)
-            return jnp.where(hit, clen - 1 - t, last)
+            if wtype == 1 and trim:
+                cbegin = lax.fori_loop(0, clen, scan_fwd,
+                                       jnp.int32(-1))
+                cend = lax.fori_loop(0, clen, scan_bwd, jnp.int32(-1))
+                chim = (cbegin < 0) | (cend < 0) | (cbegin >= cend)
+                cbegin = jnp.where(chim, 0, cbegin)
+                cend = jnp.where(chim, clen - 1, cend)
+                status = jnp.where(chim, 2, 0).astype(jnp.int32)
+            else:
+                cbegin = jnp.int32(0)
+                cend = clen - 1
+                status = jnp.int32(0)
 
-        if wtype == 1 and trim:
-            cbegin = lax.fori_loop(0, clen, scan_fwd, jnp.int32(-1))
-            cend = lax.fori_loop(0, clen, scan_bwd, jnp.int32(-1))
-            chim = (cbegin < 0) | (cend < 0) | (cbegin >= cend)
-            cbegin = jnp.where(chim, 0, cbegin)
-            cend = jnp.where(chim, clen - 1, cend)
-            status = jnp.where(chim, 2, 0).astype(jnp.int32)
-        else:
-            cbegin = jnp.int32(0)
-            cend = clen - 1
-            status = jnp.int32(0)
+            length = jnp.maximum(cend - cbegin + 1, 0)
 
-        length = jnp.maximum(cend - cbegin + 1, 0)
+            def emit(t, _):
+                node = path_u[u][clen - 1 - (cbegin + t)] \
+                    // pkr - 2
+                cons_sm[u, t // 128, t % 128] = base_u[u][node]
+                return 0
 
-        def emit(t, _):
-            node = path_s[clen - 1 - (cbegin + t)] // pkr - 2
-            cons_ref[0, pl.ds(t, 1), 0:1] = jnp.full(
-                (1, 1), base_s[node], jnp.int32)
-            return 0
+            lax.fori_loop(0, length, emit, 0)
+            mout_ref[u, 0, 0] = length
+            mout_ref[u, 1, 0] = status
 
-        lax.fori_loop(0, length, emit, 0)
-        mout_ref[0, 0:1, 0:1] = jnp.full((1, 1), length, jnp.int32)
-        mout_ref[0, 1:2, 0:1] = jnp.full((1, 1), status, jnp.int32)
+    # one DMA ships both consensuses to the VMEM output (dynamic-lane
+    # scalar stores into VMEM are not lowerable, and an SMEM output
+    # window this size gets pathologically padded by the pipeline)
+    cpo = pltpu.make_async_copy(cons_sm, cons_ref, sem)
+    cpo.start()
+    cpo.wait()
 
 
 @functools.partial(
@@ -913,8 +1132,10 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
               wtype: int, trim: int, interpret: bool = False):
     """seqs/wts: [B, D1, LP] uint8 (d=0 = backbone), meta: [B, D1, 8]
     int32 (begin, end, full_span, slen, ...), nlay/bblen: [B] int32.
+    B must be a multiple of the per-program pair factor (_S == 2).
     Returns (cons [B, V, 1] int32, mout [B, 8, 1] int32)."""
     b = seqs.shape[0]
+    assert b % _S == 0, f"batch {b} not a multiple of pair factor {_S}"
     pkr = 1
     while pkr < lp + 8:
         pkr <<= 1
@@ -928,57 +1149,60 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
         wtype=wtype, trim=trim)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b,),
+        grid=(b // _S,),
         in_specs=[
-            pl.BlockSpec((1, d1, lp), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((_S, d1, lp), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, d1, lp), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((_S, d1, lp), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, d1, 8), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((_S, d1, 8), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.SMEM),
         ],
         out_specs=(
-            pl.BlockSpec((1, v, 1), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((_S, v // 128, 128), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, 1), lambda i, *_: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_S, 8, 1), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.SMEM),
         ),
-        scratch_shapes=[
-            pltpu.VMEM((v, p), jnp.int32),       # preds
-            pltpu.VMEM((v, s_), jnp.int32),      # succs
-            pltpu.VMEM((1, wb + _N_SHIFT * 128), jnp.float32),  # stage
-            pltpu.VMEM((v, wb), jnp.float32),    # ring (node-indexed)
-            pltpu.VMEM((v, wb), jnp.int32),      # dirs (node-indexed)
-            pltpu.VMEM((8, wb), jnp.float32),    # accs
-            pltpu.VMEM((8, wb), jnp.int32),      # arga
-            pltpu.VMEM((8, lp + 256), jnp.int32),  # staged chr*256+wt
-            pltpu.VMEM((8, lp + 256), jnp.int32),  # staged chars only
-            pltpu.VMEM((v, a_), jnp.int32),      # aligned groups
-            pltpu.SMEM((v,), jnp.int32),         # base
-            pltpu.SMEM((v,), jnp.int32),         # anchor
-            pltpu.SMEM((v,), jnp.int32),         # nseqs
-            pltpu.SMEM((v,), jnp.int32),         # next
-            pltpu.SMEM((v,), jnp.int32),         # group-last
-            pltpu.SMEM((v,), jnp.int32),         # band (epoch<<8|sq)
-            pltpu.SMEM((v,), jnp.int32),         # pred count
-            pltpu.SMEM((v,), jnp.int32),         # succ count
-            pltpu.SMEM((8 * v,), jnp.int32),     # pred id mirror
-            pltpu.SMEM((v,), jnp.int32),         # order
-            pltpu.SMEM((v,), jnp.int32),         # consensus score
-            pltpu.SMEM((v,), jnp.int32),         # consensus pred
-            pltpu.SMEM((v * p,), jnp.int32),     # pred weights
-            pltpu.SMEM((v + lp,), jnp.int32),    # packed path
-            pltpu.SMEM((v,), jnp.int32),         # aligned-group count
-            pltpu.SMEM((12,), jnp.int32),        # regs
-            pltpu.SMEM((v,), jnp.int32),         # min succ anchor
-            pltpu.SMEM((8, lp + 256), jnp.int32),  # chw SMEM mirror
-            pltpu.SemaphoreType.DMA,             # chw staging sem
-        ],
+        scratch_shapes=(
+            # one ref PER WINDOW so the scheduler can prove the two
+            # interleaved walks never alias (see _kernel)
+            [pltpu.VMEM((v, p), jnp.int32)] * _S      # preds
+            + [pltpu.VMEM((v, s_), jnp.int32)] * _S   # succs
+            + [pltpu.VMEM((4, wb + _N_SHIFT * 128), jnp.int32)] * _S
+            + [pltpu.VMEM((v, wb), jnp.int32)] * _S   # packed rows
+            + [pltpu.VMEM((1, wb), jnp.float32)] * _S  # accs
+            + [pltpu.VMEM((1, wb), jnp.int32)] * _S   # arga
+            + [pltpu.VMEM((8, lp + 256), jnp.int32)]  # staged chr*w
+            + [pltpu.VMEM((8, lp + 256), jnp.int32)]  # staged chars
+            + [pltpu.VMEM((v, a_), jnp.int32)] * _S   # aligned groups
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # base
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # anchor
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # nseqs
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # next
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # group-last
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # band epoch|sq
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # pred count
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # succ count
+            + [pltpu.SMEM((8 * v,), jnp.int32)] * _S  # pred id mirror
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # order
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # cons score
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # cons pred
+            + [pltpu.SMEM((v * p,), jnp.int32)] * _S  # pred weights
+            + [pltpu.SMEM((v + lp,), jnp.int32)] * _S  # packed paths
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # aligned count
+            + [pltpu.SMEM((_NREG,), jnp.int32)] * _S  # regs
+            + [pltpu.SMEM((v,), jnp.int32)] * _S      # min succ
+            + [pltpu.SMEM((8, lp + 256), jnp.int32)]  # chw mirror
+            + [pltpu.SMEM((_S, v // 128, 128), jnp.int32)]  # consensus
+            + [pltpu.SemaphoreType.DMA]               # staging sem
+        ),
     )
+    assert v % 128 == 0, "node cap must be lane-aligned"
     return pl.pallas_call(
         kern,
         grid_spec=grid_spec,
-        out_shape=(jax.ShapeDtypeStruct((b, v, 1), jnp.int32),
+        out_shape=(jax.ShapeDtypeStruct((b, v // 128, 128), jnp.int32),
                    jax.ShapeDtypeStruct((b, 8, 1), jnp.int32)),
         interpret=interpret,
     )(nlay, bblen, seqs_l, wts_l, meta)
@@ -1016,6 +1240,21 @@ def poa_full_batch(seqs, wts, meta, nlay, bblen, **kw):
     return poa_full_dispatch(seqs, wts, meta, nlay, bblen, **kw)()
 
 
+def _pad_pairs(seqs, wts, meta, nlay, bblen, mult):
+    """Pad the batch to a multiple of ``mult`` with inert 1-base
+    windows ('A' backbone, no layers)."""
+    from racon_tpu.parallel.mesh_utils import pad_to_multiple
+
+    b0 = seqs.shape[0]
+    seqs = pad_to_multiple(seqs, mult, 0)
+    seqs[b0:, 0, 0] = ord("A")
+    wts = pad_to_multiple(wts, mult, 1)
+    meta = pad_to_multiple(meta, mult, 0)
+    nlay = pad_to_multiple(nlay, mult, 0)
+    bblen = pad_to_multiple(bblen, mult, 1)
+    return seqs, wts, meta, nlay, bblen
+
+
 def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
                       v, lp, d1, p=16, s=16, a=8, k=128, wb=256,
                       match=5, mismatch=-4, gap=-8, wtype=1, trim=1,
@@ -1028,24 +1267,18 @@ def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
     batch queues on threads, src/cuda/cudapolisher.cpp:257-336).
 
     With a multi-device ``mesh`` the batch axis is sharded across the
-    devices (callers pad the batch; this pads further to a mesh
-    multiple with inert 1-base windows)."""
+    devices (callers pad the batch; this pads further to a mesh-and-
+    pair multiple with inert 1-base windows)."""
     from racon_tpu.parallel.mesh_utils import interpret_mode
 
     n_dev = len(mesh.devices) if mesh is not None else 1
     interp = interpret_mode()
     b0 = seqs.shape[0]
+    mult = _S * n_dev
+    if b0 % mult:
+        seqs, wts, meta, nlay, bblen = _pad_pairs(
+            seqs, wts, meta, nlay, bblen, mult)
     if n_dev > 1:
-        if b0 % n_dev:
-            from racon_tpu.parallel.mesh_utils import pad_to_multiple
-
-            # inert pad windows: 1-base 'A' backbone, no layers
-            seqs = pad_to_multiple(seqs, n_dev, 0)
-            seqs[b0:, 0, 0] = ord("A")
-            wts = pad_to_multiple(wts, n_dev, 1)
-            meta = pad_to_multiple(meta, n_dev, 0)
-            nlay = pad_to_multiple(nlay, n_dev, 0)
-            bblen = pad_to_multiple(bblen, n_dev, 1)
         cons, mout = _poa_full_sharded(
             jnp.asarray(seqs), jnp.asarray(wts), jnp.asarray(meta),
             jnp.asarray(nlay), jnp.asarray(bblen), mesh=mesh,
@@ -1072,7 +1305,9 @@ def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
     mout.copy_to_host_async()
 
     def collect():
-        # slice off mesh-multiple pad rows: the contract is [B, ...]
-        return np.asarray(cons)[:b0, :, 0], np.asarray(mout)[:b0, :, 0]
+        # slice off pad rows: the contract is [B, ...]
+        c = np.asarray(cons)
+        return (c.reshape(c.shape[0], -1)[:b0, :],
+                np.asarray(mout)[:b0, :, 0])
 
     return collect

@@ -42,13 +42,27 @@ def _calib_path():
     return os.path.join(root, "calibration.json")
 
 
+def _code_salt() -> str:
+    """Hash of the device-kernel sources: rates measured for one
+    kernel generation must not govern another (write-once would
+    otherwise freeze a pre-speedup split forever after an upgrade)."""
+    import hashlib
+
+    from racon_tpu.tpu import align_pallas, poa_pallas
+    from racon_tpu.utils.aot_shelf import _source_salt
+
+    s = _source_salt(poa_pallas.__file__) + \
+        _source_salt(align_pallas.__file__)
+    return hashlib.sha1(s.encode()).hexdigest()[:8]
+
+
 def _machine_key(n_dev: int) -> str:
     try:
         import jax
         plat = jax.devices()[0].platform
     except Exception:
         plat = "unknown"
-    return f"{plat}-{n_dev}dev-{os.cpu_count()}cpu"
+    return f"{plat}-{n_dev}dev-{os.cpu_count()}cpu-{_code_salt()}"
 
 
 def get_rates(stage: str, n_dev: int, default_dev: float,

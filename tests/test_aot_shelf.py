@@ -18,10 +18,12 @@ def shelf(tmp_path, monkeypatch):
     monkeypatch.setattr(aot_shelf, "enabled", lambda: True)
     aot_shelf._mem.clear()
     aot_shelf._salts.clear()
+    aot_shelf._recorded.clear()
     # RACON_TPU_CACHE_DIR names the cache ROOT; the shelf is its aot/
     yield tmp_path / "cache" / "aot"
     aot_shelf._mem.clear()
     aot_shelf._salts.clear()
+    aot_shelf._recorded.clear()
 
 
 def _build(x, y):
