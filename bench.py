@@ -170,8 +170,10 @@ def main():
         log(f"[bench] TPU path (warm): {accel_wall:.2f}s, edit distance "
             f"{accel_dist} (reference CUDA golden 1385, "
             "test/racon_test.cpp:312)")
+        retries = getattr(pol, "align_retry_counts", {})
         log(f"[bench] stage device_align: {align_s:.2f}s, "
-            f"{align_cps / 1e9:.2f} Gcells/s (band cells)")
+            f"{align_cps / 1e9:.2f} Gcells/s (band cells), "
+            f"rung retries {retries}")
         log(f"[bench] stage device_poa: {poa_s:.2f}s, "
             f"{poa_cps / 1e9:.2f} Gcells/s (band cells)")
         # run-to-run determinism: both TPU runs must emit identical
@@ -245,6 +247,12 @@ def main():
             log(f"[bench] mega bench skipped "
                 f"({type(exc).__name__}: {exc})")
 
+        try:
+            extra.update(mega_ont_bench())
+        except Exception as exc:
+            log(f"[bench] mega_ont bench skipped "
+                f"({type(exc).__name__}: {exc})")
+
     print(json.dumps({
         "metric": "sample_e2e_polish_wall_s",
         "value": round(accel_wall, 3),
@@ -315,21 +323,16 @@ def scale_bench():
         }
 
 
-def mega_bench():
-    """Megabase-scale workload: a 4.6 Mb / 30x synthetic, the
-    E. coli-class analog of the reference's CI scale test
-    (ci/gpu/cuda_test.sh:25-33, ~4.6 Mb ONT polish).  This is where
-    megabatch utilization, HBM budgeting and the hybrid split get
-    stressed.  Default ON on TPU backends so the driver-captured BENCH
-    files carry the mega regression surface; several minutes per leg
-    (RACON_TPU_BENCH_MEGA=0 disables, RACON_TPU_BENCH_MEGA_CPU=0
-    skips just the CPU reference leg)."""
+def _mega_leg(prefix, label, sim_kwargs, tpu_need_s, cpu_need_s,
+              enable_env):
+    """Shared megabase leg runner (uniform + ONT models): simulate,
+    run the TPU hybrid, optionally the CPU reference, record
+    accuracy, rejects and device share under ``prefix``-ed keys."""
     import jax
     on_tpu = jax.devices()[0].platform == "tpu"
-    if os.environ.get("RACON_TPU_BENCH_MEGA",
-                      "1" if on_tpu else "0") != "1":
+    if os.environ.get(enable_env, "1" if on_tpu else "0") != "1":
         return {}
-    if not _budget_left(380, "mega TPU leg"):
+    if not _budget_left(tpu_need_s, f"{prefix} TPU leg"):
         return {}
     import tempfile
 
@@ -337,10 +340,8 @@ def mega_bench():
     from racon_tpu.ops import cpu
     from racon_tpu.tools import simulate
 
-    with tempfile.TemporaryDirectory(prefix="racon_mega_") as tmp:
-        reads, paf, draft = simulate.simulate(
-            tmp, genome_len=4_600_000, coverage=30, read_len=10_000,
-            seed=11)
+    with tempfile.TemporaryDirectory(prefix=f"racon_{prefix}_") as tmp:
+        reads, paf, draft = simulate.simulate(tmp, **sim_kwargs)
         truth = open(os.path.join(tmp, "genome.fasta"),
                      "rb").read().split(b"\n")[1]
 
@@ -354,39 +355,67 @@ def mega_bench():
             out = pol.polish(True)
             return time.monotonic() - t0, out, pol
 
-        # one TPU leg (compiles shared with the scale leg via the
-        # persistent cache) + one CPU reference leg
         tpu_wall, tpu_out, tpol = run(1, 1)
         d_tpu = cpu.edit_distance(tpu_out[0].data, truth)
         rejects = sum(tpol.poa_reject_counts.values())
-        dev_windows = tpol.poa_device_windows
-        total_windows = tpol.poa_eligible_windows
         out = {
-            "mega_tpu_wall_s": round(tpu_wall, 3),
-            "mega_tpu_edit_distance": int(d_tpu),
-            "mega_poa_rejects": int(rejects),
-            "mega_device_window_share": round(
-                dev_windows / max(total_windows, 1), 3),
+            f"{prefix}_tpu_wall_s": round(tpu_wall, 3),
+            f"{prefix}_tpu_edit_distance": int(d_tpu),
+            f"{prefix}_poa_rejects": int(rejects),
+            f"{prefix}_device_window_share": round(
+                tpol.poa_device_windows
+                / max(tpol.poa_eligible_windows, 1), 3),
         }
-        if os.environ.get("RACON_TPU_BENCH_MEGA_CPU", "1") == "1" \
-                and _budget_left(660, "mega CPU reference leg"):
+        if os.environ.get(f"{enable_env}_CPU", "1") == "1" \
+                and _budget_left(cpu_need_s,
+                                 f"{prefix} CPU reference leg"):
             cpu_wall, cpu_out, _ = run(0, 0)
             d_cpu = cpu.edit_distance(cpu_out[0].data, truth)
             out.update({
-                "mega_cpu_wall_s": round(cpu_wall, 3),
-                "mega_speedup": round(cpu_wall / tpu_wall, 3),
-                "mega_cpu_edit_distance": int(d_cpu),
+                f"{prefix}_cpu_wall_s": round(cpu_wall, 3),
+                f"{prefix}_speedup": round(cpu_wall / tpu_wall, 3),
+                f"{prefix}_cpu_edit_distance": int(d_cpu),
             })
-            log(f"[bench] mega (4.6Mb, 30x synthetic): CPU "
-                f"{cpu_wall:.1f}s (dist {d_cpu}), TPU {tpu_wall:.1f}s"
-                f" (dist {d_tpu}), speedup {cpu_wall / tpu_wall:.2f}x,"
-                f" {rejects} POA rejects, device share"
-                f" {out['mega_device_window_share']:.0%}")
+            log(f"[bench] {label}: CPU {cpu_wall:.1f}s (dist {d_cpu}),"
+                f" TPU {tpu_wall:.1f}s (dist {d_tpu}), speedup "
+                f"{cpu_wall / tpu_wall:.2f}x, {rejects} POA rejects, "
+                f"device share "
+                f"{out[f'{prefix}_device_window_share']:.0%}")
         else:
-            log(f"[bench] mega (4.6Mb, 30x synthetic): TPU "
-                f"{tpu_wall:.1f}s (dist {d_tpu}), {rejects} POA "
-                "rejects (CPU leg skipped)")
+            log(f"[bench] {label}: TPU {tpu_wall:.1f}s (dist {d_tpu}),"
+                f" {rejects} POA rejects (CPU leg skipped)")
         return out
+
+
+def mega_bench():
+    """Megabase-scale workload: a 4.6 Mb / 30x synthetic, the
+    E. coli-class analog of the reference's CI scale test
+    (ci/gpu/cuda_test.sh:25-33, ~4.6 Mb ONT polish).  This is where
+    megabatch utilization, HBM budgeting and the hybrid split get
+    stressed.  Default ON on TPU backends (RACON_TPU_BENCH_MEGA=0
+    disables, RACON_TPU_BENCH_MEGA_CPU=0 skips the CPU leg)."""
+    return _mega_leg(
+        "mega", "mega (4.6Mb, 30x synthetic)",
+        dict(genome_len=4_600_000, coverage=30, read_len=10_000,
+             seed=11),
+        380, 660, "RACON_TPU_BENCH_MEGA")
+
+
+def mega_ont_bench():
+    """Megabase leg on the ONT-realistic error model
+    (tools/simulate.py --ont: homopolymer-enriched genome,
+    homopolymer-biased indels, lognormal read lengths,
+    error-correlated qualities) -- the closest available stand-in for
+    the reference's real E. coli ONT CI data (S3 is unreachable
+    here).  Real ONT error structure stresses the POA band and the
+    calibrated split differently from the uniform mix, so accuracy
+    AND speedup go on record.  2.3 Mb / 30x (half the uniform mega)
+    to fit the wall budget."""
+    return _mega_leg(
+        "mega_ont", "mega_ont (2.3Mb, 30x ONT model)",
+        dict(genome_len=2_300_000, coverage=30, read_len=10_000,
+             seed=13, ont=True),
+        420, 330, "RACON_TPU_BENCH_MEGA_ONT")
 
 
 if __name__ == "__main__":

@@ -100,6 +100,12 @@ def edit_distance(query: bytes, target: bytes) -> int:
 
 def align(query: bytes, target: bytes) -> str:
     """Global alignment; returns a standard CIGAR (M covers mismatches)."""
+    return align_with_distance(query, target)[0]
+
+
+def align_with_distance(query: bytes, target: bytes):
+    """Global alignment; returns (CIGAR, edit distance) -- the
+    distance feeds the polisher's per-run divergence probe."""
     lib = get_library()
     cap = 4 * (len(query) + len(target)) + 16
     buf = ctypes.create_string_buffer(cap)
@@ -110,7 +116,7 @@ def align(query: bytes, target: bytes) -> str:
         raise RuntimeError(
             f"[racon_tpu::align] native aligner failed (code {n}) on pair "
             f"({len(query)} x {len(target)})")
-    return buf.raw[:n].decode()
+    return buf.raw[:n].decode(), int(dist.value)
 
 
 class PoaEngine:

@@ -90,6 +90,11 @@ class TPUPolisher(Polisher):
         self._mesh = None
         # DP-cell counters + stage walls for throughput reporting
         self.align_cells = 0
+        # starting-rung mispredictions per band (bench observability)
+        self.align_retry_counts = {}
+        # per-run probed dataset divergence (see _probe_divergence)
+        self.align_probe_ratio = 1 / 3
+        self.align_probe_p50 = 1 / 4
         self.poa_cells = 0
         self.poa_reject_counts = {}
         # hybrid observability: windows consensused on device vs total
@@ -377,7 +382,10 @@ class TPUPolisher(Polisher):
         # cleanly.
         dev_w = sum(w for w, _ in meas["dev"][1:])
         dev_u = sum(u for _, u in meas["dev"][1:])
-        if dev_u > 0 and meas["cpu_u"] > 0:
+        _, _, _src = calibrate.get_rates("poa", n_dev, 0.30, 2.0)
+        if dev_u > 0 and meas["cpu_u"] > 0 and _src != "env":
+            # env-pinned runs (CI, tests) never mutate the machine's
+            # calibration cache
             calibrate.store_rates(
                 "poa", n_dev, dev_w * 1e6 * n_dev / dev_u,
                 meas["cpu_w"] * 1e6 / meas["cpu_u"])
@@ -504,6 +512,38 @@ class TPUPolisher(Polisher):
         else:
             self._hybrid_scan_align(pending)
 
+    def _probe_divergence(self, pending, cpu_ops) -> float:
+        """CPU-align a deterministic spread of ~9 pending pairs and
+        return the p75 of edit distance / dimension -- the dataset's
+        divergence, which feeds both the WFA CPU cost model and the
+        device band starting rung.  A property of the DATA, so it is
+        probed per run rather than persisted per machine (a ratio
+        learned on 10%-divergence data starved a 25%-divergence run).
+        Probed pairs keep their breaking points and leave ``pending``,
+        so the probe's work is never repeated; edit distances are
+        exact, keeping the split a pure function of the input."""
+        n = len(pending)
+        if n < 4:
+            return 1 / 3
+        idxs = sorted({min(n - 1, int(q * n))
+                       for q in (0.1, 0.2, 0.3, 0.4, 0.5,
+                                 0.6, 0.7, 0.8, 0.9)})
+
+        def one(i):
+            d, o = pending[i]
+            q = o.query_span(self.sequences)
+            t = o.target_span(self.sequences)
+            cigar, dist = cpu_ops.align_with_distance(q, t)
+            o.cigar = cigar
+            o.find_breaking_points(self.sequences, self.window_length)
+            return dist / max(d, 1)
+
+        ratios = sorted(self._pool.map(one, idxs))
+        for i in reversed(idxs):
+            del pending[i]
+        self.align_probe_p50 = ratios[(len(ratios) - 1) // 2]
+        return ratios[int(0.75 * (len(ratios) - 1))]
+
     def _hybrid_pallas_align(self, pending) -> None:
         """Stacked-kernel-first hybrid: the device owns a prefix of
         the length-sorted queue (one dispatch per band rung, all
@@ -524,11 +564,35 @@ class TPUPolisher(Polisher):
         from racon_tpu.utils import calibrate
 
         n_workers = self._tail_workers("RACON_TPU_ALIGN_DEVICE_ONLY")
-        dims = [d for d, _ in pending]
         n_dev = len(self.mesh.devices)
         r_dev, r_cpu, r_src = calibrate.get_rates(
             "align", n_dev, float(self.DEV_NS_PER_ROW),
             float(self.CPU_NS_PER_CELL))
+        if r_src != "env":
+            # the CPU rate calibrates as its own stage: the device
+            # rate only stores on multi-chunk runs, and entangling the
+            # two meant the CPU measurement was silently dropped
+            # whenever the device side had a single chunk.  An env pin
+            # (RACON_TPU_RATE_ALIGN_{DEV,CPU} -- CI's golden configs,
+            # tests/conftest.py) still pins BOTH rates above.
+            r_cpu, _, _ = calibrate.get_rates(
+                "align_cpu", n_dev, float(self.CPU_NS_PER_CELL), 1.0)
+        # CPU cost model: the native engine is WFA, O(d + s^2) in the
+        # DISTANCE s, not O(d^2) full DP -- at 10-15% divergence that
+        # is a ~100x difference, and the old d^2 model starved the CPU
+        # side of work it does in milliseconds.  s is estimated as
+        # ratio * d (measured r5 on 11 kb pairs: with ratio 0.114 and
+        # the 4.0 ns/cell default this model predicts 6.8/14.7/25.8 ms
+        # per pair at 10/15/20% divergence -- the measured values to
+        # within 5%).
+        probe_ratio = self._probe_divergence(pending, cpu_ops)
+        ratio = min(max(probe_ratio, 0.05), 0.67)
+        self.align_probe_ratio = ratio
+        dims = [d for d, _ in pending]
+
+        def cpu_cells(d):
+            return d + (ratio * d) ** 2
+
         if not n_workers:
             cut = len(pending)
         elif "RACON_TPU_ALIGN_SPLIT" in os.environ:
@@ -538,7 +602,7 @@ class TPUPolisher(Polisher):
         else:
             cut = _rate_split(
                 [d * r_dev / n_dev for d in dims],
-                [r_cpu * d * d / n_workers for d in dims])
+                [r_cpu * cpu_cells(d) / n_workers for d in dims])
 
         work = deque(pending[cut:])
         lock = threading.Lock()
@@ -559,7 +623,7 @@ class TPUPolisher(Polisher):
                                        aligner=cpu_ops.align)
                 with lock:
                     meas["cpu_w"] += _time.monotonic() - t1
-                    meas["cpu_u"] += float(d) * d
+                    meas["cpu_u"] += cpu_cells(float(d))
 
         workers = [self._pool.submit(cpu_worker)
                    for _ in range(n_workers)]
@@ -568,6 +632,19 @@ class TPUPolisher(Polisher):
             self._pallas_align([o for _, o in pending[:cut]])
         for f in workers:
             f.result()
+        # the WFA-shaped CPU rate (ns per modeled cell) transfers
+        # across workloads better than the old d^2 model because the
+        # divergence enters through the probed ratio, not the rate;
+        # structured indels still inflate it (measured r5: ~4 ns on a
+        # uniform-error synthetic, ~9 ns on real ONT), which the
+        # two-pass machine calibration averages over
+        if meas["cpu_u"] > 0 and n_cpu_done >= 16 and r_src != "env":
+            # never persist measurements from env-pinned runs (CI and
+            # the test suite pin rates; their runs must not mutate the
+            # user's calibration cache)
+            calibrate.store_rates(
+                "align_cpu", n_dev,
+                meas["cpu_w"] * 1e9 / meas["cpu_u"])
         if cut:
             # drop the first dispatch per band rung and store only
             # when later chunks exist: first dispatches pay one-time
@@ -580,11 +657,7 @@ class TPUPolisher(Polisher):
                         for w, _ in ch[1:])
             dev_rows = sum(r for ch in by_rung.values()
                            for _, r in ch[1:])
-            if dev_rows > 0:
-                # device ns/row transfers across workloads (same
-                # kernel math per row); the CPU d^2 model does not
-                # (WFA cost tracks divergence, which varies by
-                # dataset), so only the device side is calibrated
+            if dev_rows > 0 and r_src != "env":
                 calibrate.store_rates(
                     "align", n_dev, dev_w * 1e9 * n_dev / dev_rows)
         if n_cpu_done:
@@ -688,19 +761,25 @@ class TPUPolisher(Polisher):
                   max(len(s) for s in targets))
         bd = min((dim + 127) // 128 * 128, self.max_align_dim)
         # per-pair starting rung from the expected cost (length
-        # difference, ~20% ONT divergence), like the scan ladder --
-        # running a guaranteed-to-fail narrow band doubles the work
+        # difference, divergence-scaled dimension), like the scan
+        # ladder -- running a guaranteed-to-fail narrow band doubles
+        # the work, while starting too wide wastes band columns.
         # Ukkonen certificate for the proportional-diagonal band: a
         # path of cost c deviates at most (c + |dlen|) / 2 columns
         # from the diagonal, so a band of wb columns (quantized 128,
         # margin wb/2 - 256 per side) certifies
         # cost + |dlen| <= wb - 512.
-        # starting rung from the expected cost: sample ONT overlaps
-        # measure 25-35% band cost relative to their dimension, so /3
-        # (a /5 estimate sent ~85% of the first rung to a retry)
+        # The starting rung uses the probe's MEDIAN divergence: a rung
+        # retry costs (1 + retry_fraction) of the band where starting
+        # a rung higher costs 2x for everyone, so the median pair
+        # should start at the rung that just certifies it (the p75
+        # the CPU cost model uses pushed every sample pair up a rung
+        # when the distribution sat at a certify boundary).  The
+        # retry counters below keep mispredictions visible.
+        ratio = min(max(self.align_probe_p50, 0.05), 0.67)
         dabs = [abs(len(q) - len(t))
                 for q, t in zip(queries, targets)]
-        need = [max(dabs[i], max(len(q), len(t)) // 3)
+        need = [max(dabs[i], int(max(len(q), len(t)) * ratio))
                 for i, (q, t) in enumerate(zip(queries, targets))]
         pending = list(range(len(overlaps)))
         rungs = (2048, 4096, 8192)
@@ -714,6 +793,11 @@ class TPUPolisher(Polisher):
                    if need[i] + dabs[i] <= wb - 512
                    or (wb == rungs[-1] and 2 * dabs[i] <= wb - 512)]
             if not idx:
+                continue
+            if len(idx) < 16 and wb != rungs[-1]:
+                # a sub-16-pair batch pays a whole dispatch (and often
+                # a fresh compiled variant) for almost no work; let
+                # the stragglers ride the next rung's batch instead
                 continue
             # chunk the dispatch so one batch's device footprint
             # (checkpoint HBM region + q/t/tape) stays in budget
@@ -750,9 +834,14 @@ class TPUPolisher(Polisher):
             idx_set = set(idx)
             pending = [i for i in pending
                        if i in still or i not in idx_set]
+            # mispredicted starting rungs double-pay the kernel; the
+            # counter keeps that visible (bench prints it)
+            self.align_retry_counts[wb] = \
+                self.align_retry_counts.get(wb, 0) + len(still)
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::align] device-aligned "
-                f"{n_cert}/{len(idx)} overlaps (band {wb})")
+                f"{n_cert}/{len(idx)} overlaps (band {wb}"
+                + (f", {len(still)} retries" if still else "") + ")")
         # survivors lack a CIGAR and take the CPU fall-through
         # (the reference's exceeded_max_alignment_difference skip)
 

@@ -99,13 +99,17 @@ def get_rates(stage: str, n_dev: int, default_dev: float,
 
 def store_rates(stage: str, n_dev: int, dev_rate: float,
                 cpu_rate=None) -> None:
-    """Persist measured rates (write-once per machine key + stage;
-    RACON_TPU_RECALIBRATE=1 overwrites).  ``cpu_rate=None`` stores the
-    device rate only -- used by stages whose CPU cost model does not
-    transfer across workloads (the aligner's d^2 model fitted on one
-    dataset's tail misprices another's divergence), so the measured
-    device rate combines with the conservative CPU default.  Never
-    raises."""
+    """Persist measured rates (two-pass-then-frozen per machine key +
+    stage; RACON_TPU_RECALIBRATE=1 always overwrites).  The FIRST
+    measurement runs under the conservative default split, which
+    biases it (an underfed engine measures slow); one refinement pass
+    under the first-generation split converges the estimate, after
+    which rates freeze so the chosen split -- and output bytes -- stay
+    reproducible across runs.  ``cpu_rate=None`` stores the device
+    rate only -- used by stages whose CPU cost model does not transfer
+    across workloads (the aligner's d^2 model fitted on one dataset's
+    tail misprices another's divergence), so the measured device rate
+    combines with the conservative CPU default.  Never raises."""
     if not dev_rate > 0 or (cpu_rate is not None and not cpu_rate > 0):
         return
     try:
@@ -121,10 +125,12 @@ def store_rates(stage: str, n_dev: int, dev_rate: float,
             except Exception:
                 pass
             ent = data.setdefault(mkey, {})
-            if stage in ent and \
+            old = ent.get(stage)
+            if old and old.get("gen", 1) >= 2 and \
                     not os.environ.get("RACON_TPU_RECALIBRATE"):
                 return
-            ent[stage] = {"dev": round(dev_rate, 4)}
+            gen = old.get("gen", 1) + 1 if old else 1
+            ent[stage] = {"dev": round(dev_rate, 4), "gen": gen}
             if cpu_rate is not None:
                 ent[stage]["cpu"] = round(cpu_rate, 4)
             os.makedirs(os.path.dirname(path), exist_ok=True)

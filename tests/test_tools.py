@@ -116,3 +116,51 @@ def test_wrapper_split_polish_equals_unsplit(tmp_path):
     assert b"target split into 2 chunk(s)" in split.stderr
     assert split.stdout == unsplit.stdout
     assert unsplit.stdout.count(b">") == 2
+
+
+def test_ont_simulator_error_structure(tmp_path):
+    """The --ont model must produce what it advertises: enriched
+    homopolymer runs, lognormal-varied read lengths, and base
+    qualities that are LOW near real errors (reference analog: the
+    real E. coli ONT CI data, ci/gpu/cuda_test.sh:25-33)."""
+    import numpy as np
+
+    from racon_tpu.tools import simulate
+
+    reads, paf, draft = simulate.simulate(
+        str(tmp_path), genome_len=60_000, coverage=8, read_len=4000,
+        seed=3, ont=True)
+    genome = open(tmp_path / "genome.fasta", "rb").read() \
+        .split(b"\n")[1]
+    g = np.frombuffer(genome, np.uint8)
+    runs = np.diff(np.flatnonzero(
+        np.concatenate(([True], np.diff(g) != 0, [True]))))
+    # uniform-random ACGT virtually never reaches 10+ runs at 60 kb;
+    # the enriched genome must
+    assert runs.max() >= 10, f"max homopolymer run {runs.max()}"
+
+    lengths, lowq_frac = [], []
+    with open(reads, "rb") as fh:
+        while True:
+            header = fh.readline()
+            if not header:
+                break
+            seq = fh.readline().strip()
+            fh.readline()
+            qual = np.frombuffer(fh.readline().strip(), np.uint8) - 33
+            lengths.append(len(seq))
+            lowq_frac.append(float((qual < 30).mean()))
+    lengths = np.array(lengths)
+    assert lengths.std() > 0.2 * lengths.mean(), "lengths not varied"
+    # ~10% error rate with +-1 dilation -> roughly 15-45% low-quality
+    # bases; uniform quality would give ~0
+    assert 0.05 < np.mean(lowq_frac) < 0.6, np.mean(lowq_frac)
+
+    # qualities must CORRELATE with errors: polishing with them should
+    # succeed (smoke: the polisher consumes the fastq + paf unchanged)
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    pol = create_polisher(reads, paf, draft, PolisherType.kC, 500,
+                          10.0, 0.3, True, 5, -4, -8, num_threads=4)
+    pol.initialize()
+    out = pol.polish(True)
+    assert len(out) == 1 and len(out[0].data) > 50_000
