@@ -263,11 +263,16 @@ def main():
         "cpu_edit_distance": int(cpu_dist),
         **extra,
     }))
+    sys.stdout.flush()
+    sys.stderr.flush()
     if not extra.get("deterministic", True):
         # a nondeterministic TPU path is a regression, not a footnote
         # (the reference diffs full output byte-for-byte in CI,
         # ci/gpu/cuda_test.sh:33) -- fail the bench run
-        sys.exit(1)
+        os._exit(1)
+    # hard-exit: the JSON line above is the contract, and background
+    # prewarm compiles must not stall (or abort) interpreter teardown
+    os._exit(0)
 
 
 def scale_bench():

@@ -16,6 +16,7 @@ negative threshold always passes).
 
 from __future__ import annotations
 
+import os
 import sys
 
 from racon_tpu import __version__
@@ -196,7 +197,12 @@ def main(argv=None):
     for seq in polished:
         out.write(b">" + seq.name.encode() + b"\n" + seq.data + b"\n")
     out.flush()
-    return 0
+    # hard-exit once the output is flushed: background prewarm
+    # compiles may still be in flight, and waiting for them (or
+    # letting interpreter teardown abort them mid-C++-call) serves no
+    # one -- the binary's contract is the bytes on stdout
+    sys.stderr.flush()
+    os._exit(0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,137 @@
+"""Synthetic POA kernel microbenchmark: ``python -m racon_tpu.tools.poa_bench``.
+
+Measures the flagship on-device POA kernel (racon_tpu/tpu/poa_pallas.py)
+in isolation on a realistic synthetic megabatch -- the unit the round-5
+throughput work tunes, decoupled from the polish pipeline's host stages.
+The workload mirrors the reference CI sample's window statistics
+(~500 bp windows, ~30 layers, ~12% read error), the same shape class the
+mega bench's megabatches take.
+
+Prints one line per run: wall seconds, Gcells/s (DP rank steps x band
+columns, matching the polish pipeline's poa_cells accounting) and the
+reject count (must be 0).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def make_workload(b: int, depth: int, wlen: int, lp: int,
+                  err: float, seed: int):
+    """Synthetic megabatch: b windows of ``depth`` layers, each layer a
+    noisy copy of a per-window backbone (substitutions, indels at
+    ``err`` combined rate -- the uniform mix tools/simulate.py uses)."""
+    rng = np.random.default_rng(seed)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    d1 = depth + 1
+    seqs = np.zeros((b, d1, lp), np.uint8)
+    wts = np.ones((b, d1, lp), np.uint8)
+    meta = np.zeros((b, d1, 8), np.int32)
+    nlay = np.full((b,), depth, np.int32)
+    bblen = np.full((b,), wlen, np.int32)
+    for i in range(b):
+        bb = bases[rng.integers(0, 4, wlen)]
+        seqs[i, 0, :wlen] = bb
+        for d in range(1, depth + 1):
+            # mutate: per-position choose keep/sub/del, plus insertions
+            r = rng.random(wlen)
+            keep = r >= err
+            sub = (r < err * 0.5)
+            seq = bb.copy()
+            seq[sub] = bases[rng.integers(0, 4, int(sub.sum()))]
+            seq = seq[keep | sub]
+            ins_at = rng.random(seq.size) < err * 0.25
+            n_ins = int(ins_at.sum())
+            if n_ins:
+                out = np.insert(seq, np.flatnonzero(ins_at),
+                                bases[rng.integers(0, 4, n_ins)])
+            else:
+                out = seq
+            out = out[:lp]
+            seqs[i, d, :out.size] = out
+            wts[i, d, :out.size] = rng.integers(10, 40, out.size)
+            meta[i, d, 0] = 0
+            meta[i, d, 1] = wlen - 1
+            meta[i, d, 2] = 1          # full span
+            meta[i, d, 3] = out.size
+    return seqs, wts, meta, nlay, bblen
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-b", type=int, default=96, help="windows")
+    ap.add_argument("--depth", type=int, default=30)
+    ap.add_argument("--wlen", type=int, default=500)
+    ap.add_argument("--err", type=float, default=0.12)
+    ap.add_argument("--v", type=int, default=2048)
+    ap.add_argument("--lp", type=int, default=1024)
+    ap.add_argument("--wb", type=int, default=0,
+                    help="band columns (0 = auto policy)")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--prof", type=int, default=0,
+                    help="kernel profiling bitmask (1 = skip "
+                         "traceback+merge, 2 = skip gap chain); "
+                         "results are WRONG, timing only")
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from racon_tpu.tpu import poa_pallas
+
+    wb = args.wb or poa_pallas.band_width(args.lp)
+    d1 = args.depth + 1
+    data = make_workload(args.b, args.depth, args.wlen, args.lp,
+                         args.err, args.seed)
+    if not poa_pallas.fits(args.v, args.lp, d1, 16, 16, 8, wb):
+        print(f"config does not fit: v={args.v} lp={args.lp} "
+              f"d1={d1} wb={wb}")
+        return 1
+    s_win = poa_pallas.pick_windows_per_program(
+        args.v, args.lp, d1, 16, 16, 8, wb)
+
+    def run_batch():
+        if args.prof:
+            # direct _poa_full call (bypasses the AOT shelf: prof
+            # variants must not pollute it)
+            import numpy as np
+            sq, wt, me, nl, bb = data
+            b0 = sq.shape[0]
+            assert b0 % s_win == 0
+            cons, mout = poa_pallas._poa_full(
+                jnp.asarray(sq), jnp.asarray(wt), jnp.asarray(me),
+                jnp.asarray(nl), jnp.asarray(bb),
+                args.v, args.lp, d1, 16, 16, 8, 128, wb,
+                5, -4, -8, 1, 1, s_win, False, args.prof)
+            return (np.asarray(cons).reshape(b0, -1),
+                    np.asarray(mout)[:, :, 0])
+        return poa_pallas.poa_full_batch(
+            *data, v=args.v, lp=args.lp, d1=d1, wb=wb)
+
+    # untimed first call: trace + compile (or shelf load)
+    cons, mout = run_batch()
+    fails = int((mout[:, 0] < 0).sum())
+    ranks = int(mout[:, 4].sum())
+    cells = ranks * wb
+    print(f"[poa_bench] b={args.b} depth={args.depth} wlen={args.wlen}"
+          f" v={args.v} lp={args.lp} wb={wb} s_win={s_win} "
+          f"rank_steps={ranks} fails={fails}")
+    best = float("inf")
+    for r in range(args.reps):
+        t0 = time.monotonic()
+        cons, mout = run_batch()
+        wall = time.monotonic() - t0
+        best = min(best, wall)
+        print(f"[poa_bench] run {r}: {wall:.3f}s "
+              f"{cells / wall / 1e9:.3f} Gcells/s")
+    print(f"[poa_bench] best: {best:.3f}s "
+          f"{cells / best / 1e9:.3f} Gcells/s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -436,11 +436,16 @@ def per_pair_bytes(bd: int, wb: int) -> int:
 
 
 def pad_pairs(n: int, n_dev: int = 1) -> int:
-    """Batch padding rule: power of two, a multiple of the stacking
-    factor and of the mesh size."""
+    """Batch padding rule: power of two (floor 32), a multiple of the
+    stacking factor and of the mesh size.  The floor keeps the
+    compiled-variant set small enough for the prebuild manifest to
+    cover it: a final-rung straggler batch of 8 pairs would otherwise
+    mint its own kernel variant whose first-contact compile costs far
+    more than 24 empty lanes ever will (empty pairs cost ~nothing --
+    the row loops follow real lengths)."""
     from racon_tpu.utils.tuning import pow2_at_least
 
-    n_pad = pow2_at_least(max(n, _S), _S)
+    n_pad = pow2_at_least(max(n, 32), _S)
     return n_pad + (-n_pad) % (_S * n_dev)
 
 
@@ -483,16 +488,14 @@ def _align_sharded(q, t, ql, tl, *, mesh, lq: int, lt: int, wb: int,
     return shard_batch_map(shard_fn, mesh, 4, 2)(q, t, ql, tl)
 
 
-def align_batch(queries, targets, lq: int, lt: int, wb: int,
-                mesh=None):
-    """Align padded pair batches; returns (moves, lens, dists).
-
-    moves: [B, n] uint8 of 2-bit codes in traceback (reversed) order,
-    lens: [B] number of valid moves, dists: [B] band edit distance
-    (_BIG when the endpoint fell outside the band).  The batch is
-    padded to a multiple of the per-program stacking factor (and of
-    the mesh size, over which the batch axis is sharded).
-    """
+def align_dispatch(queries, targets, lq: int, lt: int, wb: int,
+                   mesh=None):
+    """Enqueue one aligner batch and return a zero-arg collect
+    closure producing (moves, lens, dists) -- the async half of
+    ``align_batch``.  A caller can dispatch chunk k+1 (and run host
+    decode for chunk k) while chunk k computes, hiding the tunnel's
+    per-transfer latency behind device time (the POA megabatch
+    pipeline's analog, racon_tpu/tpu/polisher.py)."""
     from racon_tpu.tpu.aligner import encode_batch, _QPAD, _TPAD
 
     n_real = len(queries)
@@ -523,14 +526,28 @@ def align_batch(queries, targets, lq: int, lt: int, wb: int,
             (q, t, ql, tl))
     tape.copy_to_host_async()
     meta.copy_to_host_async()
-    tape = np.asarray(tape)[:n_real].reshape(n_real, -1) \
-        .astype(np.uint32)
-    meta = np.asarray(meta)[:n_real, :, 0]
-    n = tape.shape[1] * 16
-    moves = np.zeros((tape.shape[0], n), np.uint8)
-    for sh in range(16):
-        moves[:, sh::16] = (tape >> (2 * sh)) & 3
-    return moves, meta[:, 1], meta[:, 0]
+
+    def collect():
+        tp = np.asarray(tape)[:n_real].reshape(n_real, -1) \
+            .astype(np.uint32)
+        mt = np.asarray(meta)[:n_real, :, 0]
+        n = tp.shape[1] * 16
+        moves = np.zeros((tp.shape[0], n), np.uint8)
+        for sh in range(16):
+            moves[:, sh::16] = (tp >> (2 * sh)) & 3
+        return moves, mt[:, 1], mt[:, 0]
+
+    return collect
+
+
+def align_batch(queries, targets, lq: int, lt: int, wb: int,
+                mesh=None):
+    """Align padded pair batches; returns (moves, lens, dists).
+
+    moves: [B, n] uint8 of 2-bit codes in traceback (reversed) order,
+    lens: [B] number of valid moves, dists: [B] band edit distance
+    (_BIG when the endpoint fell outside the band)."""
+    return align_dispatch(queries, targets, lq, lt, wb, mesh=mesh)()
 
 
 def moves_to_ops(moves_row, length, query: bytes, target: bytes):

@@ -2,25 +2,28 @@
 
 One grid program runs the ENTIRE partial-order-alignment consensus --
 graph construction, per-layer banded DP, traceback, graph merge,
-heaviest-bundle consensus, TGS trim -- for a PAIR of windows, with
-both POA graphs resident in VMEM/SMEM.  This is the cudapoa
-architecture (reference: one CUDA thread block per POA group,
+heaviest-bundle consensus, TGS trim -- for a GROUP of S windows
+(``pick_windows_per_program``: 3 at the stock w=500 caps, 1 at
+w=1000), with all S POA graphs resident in VMEM/SMEM.  This is the
+cudapoa architecture (reference: one CUDA thread block per POA group,
 src/cuda/cudabatch.cpp:52-265) mapped to the TensorCore: host
 involvement is ONE upload of the layer sequences and ONE download of
 the finished consensus per megabatch.
 
-Why a pair per program?  The per-rank DP is a serial dependency chain
-(pred row -> fold -> move max -> log2(wb) gap-chain steps -> row
-store), and measurement shows the kernel is bound by that chain's
-LATENCY, not by op count or vector width: duplicating any individual
-phase inside the rank body costs ~nothing (the VLIW scheduler hides
-it in the chain's stalls), while running the whole walk twice costs
-the full +78%.  A second window's chain is exactly such independent
-work: interleaving two windows' rank bodies in one straight-line
-region lets the scheduler fill one chain's stalls with the other's
-ops, targeting ~2x per-window throughput at unchanged op count.
-Scaling past 2 is capped by SMEM: each window's per-node scalars
-(~37 ints/node after the r5 diet) must stay scalar-addressable.
+Why several windows per program?  The per-rank DP is a serial
+dependency chain (pred row -> fold -> move max -> log2(wb) gap-chain
+steps -> row store), and measurement shows the kernel is bound by
+that chain's LATENCY, not by op count or vector width: duplicating
+any individual phase inside the rank body costs ~nothing (the VLIW
+scheduler hides it in the chain's stalls), while running the whole
+walk twice costs the full +78%.  Another window's chain is exactly
+such independent work: interleaving S windows' rank bodies in one
+straight-line region lets the scheduler fill one chain's stalls with
+the others' ops, targeting ~Sx per-window throughput at unchanged op
+count.  S is capped by SMEM: each window's per-node scalars must
+stay scalar-addressable (26 ints/node after the r5 diet: the
+consensus-phase arrays alias layer-phase arrays that are dead by
+consensus time, and pred-weight slots 8+ spill to a VMEM row).
 
 Why not the lockstep host-graph design (racon_tpu/tpu/poa.py)?  On
 the tunneled-TPU deployment target, host<->device transfers cost
@@ -73,7 +76,6 @@ from jax.experimental.pallas import tpu as pltpu
 _BIG = 1 << 28
 _N_SHIFT = 4          # pred band may lag <= 3 quanta of 128
 _INF32 = np.int32(2147483647 // 2)
-_S = 2                # windows per grid program (see module docstring)
 
 # fail codes (observability parity with the lockstep export codes)
 FAIL_VCAP = 1
@@ -84,7 +86,6 @@ FAIL_ALIGNED = 4
 FAIL_PATH = 5
 
 _NREG = 16            # regs slots per window
-_PNEG = np.int32(-(1 << 24) * 64)   # packed -inf row value
 
 
 def available() -> bool:
@@ -137,78 +138,129 @@ def prewarm(b: int, d1: int, *, v: int, lp: int, wb: int,
                    mesh=mesh)
 
 
+def _fits_s(v: int, lp: int, d1: int, p: int, s: int, a: int,
+            wb: int, s_win: int) -> bool:
+    """Conservative per-program VMEM/SMEM estimate for the kernel at
+    ``s_win`` windows per program."""
+    pw = max(p - 8, 1)
+    vmem = (s_win * v * wb * 4                # packed score|code rows
+            + s_win * v * (p + s) * 4         # adjacency ids (VMEM)
+            + s_win * v * a * 4               # aligned groups
+            + s_win * v * pw * 4              # pred-weight spill rows
+            + 2 * 8 * (lp + 256) * 4          # staged chw + chars rows
+            + 2 * 2 * s_win * d1 * lp * 4)    # seq/wts blocks x2 buf
+    # SMEM per window after the r5 diet: 10 v-sized scalar arrays
+    # (base/anchor/nseq/next/glast/bandq/pcnt/scnt/gcnt/minsucc; the
+    # consensus score/cpred/order alias anchor/bandq/glast), the
+    # 8-slot pred id mirror and 8-slot pred weights, the packed path
+    # and regs; shared: the chw mirror and the consensus staging
+    smem = (s_win * (v * (10 + 8 + 8) + (v + lp) + _NREG)
+            + 8 * (lp + 256) + s_win * (v // 128) * 128
+            + s_win * d1 * 8) * 4
+    # the kernel is granted a 64M scoped-vmem limit (v5e has 128M);
+    # leave ~40M headroom for the compiler's stack temporaries, which
+    # scale with s_win (measured r5: ~3M per interleaved window body
+    # at d1=32)
+    return vmem <= (24 << 20) and smem <= (768 << 10)
+
+
+def pick_windows_per_program(v: int, lp: int, d1: int, p: int = 16,
+                             s: int = 16, a: int = 8,
+                             wb: int = 256) -> int:
+    """Largest windows-per-program factor the budget allows (0 = the
+    shape does not fit at all and the caller must use the lockstep
+    engine).  More windows per program = more independent serial DP
+    chains for the VLIW scheduler to interleave (see module
+    docstring); the stock w=500 config fits 3, the w=1000 config 1."""
+    force = os.environ.get("RACON_TPU_POA_SWIN")
+    if force:
+        sf = int(force)
+        return sf if _fits_s(v, lp, d1, p, s, a, wb, sf) else 0
+    for s_win in (3, 2, 1):
+        if _fits_s(v, lp, d1, p, s, a, wb, s_win):
+            return s_win
+    return 0
+
+
 def fits(v: int, lp: int, d1: int, p: int, s: int, a: int,
          wb: int) -> bool:
-    """Conservative per-program VMEM/SMEM estimate for the PAIRED
-    kernel (two windows per program).  Configurations over budget
-    (e.g. -w 1000 doubles every cap) use the lockstep engine instead
-    of failing to compile."""
-    vmem = (_S * v * wb * 4                   # packed score|code rows
-            + _S * v * (p + s) * 4            # adjacency ids (VMEM)
-            + _S * v * a * 4                  # aligned groups
-            + 2 * 8 * (lp + 256) * 4          # staged chw + chars rows
-            + _S * (wb + _N_SHIFT * 128) * 4  # pred-fold staging rows
-            + 2 * 2 * _S * d1 * lp * 4)       # seq/wts blocks x2 buf
-    # SMEM: per-node scalars + pred mirror + weights + the packed
-    # path per window, plus the shared chw mirror and the SMEM
-    # consensus outputs
-    smem = (_S * (v * (p + 8 + 13) + (v + lp) + v + 8)
-            + 8 * (lp + 256) + _S * d1 * 8) * 4
-    # the Mosaic scoped-vmem limit is 16M; leave ~5M for the
-    # compiler's stack temporaries (measured r5: the paired body's
-    # temps cost ~6M at d1=32 before the row packing)
-    return vmem <= (11 << 20) and smem <= (768 << 10)
+    """True when the flagship kernel can run this shape at SOME
+    windows-per-program factor.  Configurations over budget use the
+    lockstep engine instead of failing to compile."""
+    return pick_windows_per_program(v, lp, d1, p, s, a, wb) > 0
+
+
+def padded_batch(b: int, n_dev: int, v: int, lp: int, d1: int,
+                 p: int = 16, s: int = 16, a: int = 8,
+                 wb: int = 256) -> int:
+    """The batch size dispatch will actually run for a caller-side
+    batch of ``b``: rounded up to a multiple of the windows-per-program
+    factor times the device count.  Prewarm/prebuild paths must
+    predict THIS number or they compile a variant production never
+    uses (and the AOT-shelf key never matches)."""
+    s_win = max(1, pick_windows_per_program(v, lp, d1, p, s, a, wb))
+    mult = s_win * max(1, n_dev)
+    return b + (-b) % mult
+
+
+_SCRATCH_PER_WIN = ("preds", "succs", "ring", "accs",
+                    "arga", "aligsm", "predwv", "base", "anch",
+                    "nseq", "nxt", "glast", "bandq", "pcnt", "scnt",
+                    "predsm", "predw", "path", "gcnt", "regs",
+                    "minsucc")
 
 
 def _kernel(nlay_ref, bblen_ref,
             seqs_ref, wts_ref, meta_ref,
-            cons_ref, mout_ref,
-            preds_a, preds_b, succs_a, succs_b, stage_a, stage_b,
-            ring_a, ring_b, accs_a, accs_b, arga_a, arga_b,
-            chw_v, chars_v, aligsm_a, aligsm_b,
-            base_a, base_b, anch_a, anch_b, nseq_a, nseq_b,
-            nxt_a, nxt_b, glast_a, glast_b, bandq_a, bandq_b,
-            pcnt_a, pcnt_b, scnt_a, scnt_b, predsm_a, predsm_b,
-            order_a, order_b, score_a, score_b, cpred_a, cpred_b,
-            predw_a, predw_b, path_a, path_b, gcnt_a, gcnt_b,
-            regs_a, regs_b, minsucc_a, minsucc_b,
-            chw_s, cons_sm, sem, *,
+            cons_ref, mout_ref, *scr,
             v: int, lp: int, d1: int, p: int, s_: int, a_: int,
-            k: int, wb: int,
+            k: int, wb: int, s_win: int,
             match: int, mismatch: int, gap: int,
-            wtype: int, trim: int):
+            wtype: int, trim: int, prof: int = 0):
+    S = s_win
     i = pl.program_id(0)
-    nlay_u = [nlay_ref[_S * i + u] for u in range(_S)]
-    bbl_u = [bblen_ref[_S * i + u] for u in range(_S)]
-    # every per-window array is its own ref: the two windows' walks
+    nlay_u = [nlay_ref[S * i + u] for u in range(S)]
+    bbl_u = [bblen_ref[S * i + u] for u in range(S)]
+    # every per-window array is its own ref: the S windows' walks
     # interleave in one straight-line region, and DISTINCT refs are
     # what lets the scheduler prove window B's loads cannot alias
     # window A's stores (a shared ref with u*v offsets serializes the
-    # pair -- measured r5: zero speedup from pairing until the split)
-    preds_u = (preds_a, preds_b)
-    succs_u = (succs_a, succs_b)
-    stage_u = (stage_a, stage_b)
-    ring_u = (ring_a, ring_b)
-    accs_u = (accs_a, accs_b)
-    arga_u = (arga_a, arga_b)
-    aligsm_u = (aligsm_a, aligsm_b)
-    base_u = (base_a, base_b)
-    anch_u = (anch_a, anch_b)
-    nseq_u = (nseq_a, nseq_b)
-    nxt_u = (nxt_a, nxt_b)
-    glast_u = (glast_a, glast_b)
-    bandq_u = (bandq_a, bandq_b)
-    pcnt_u = (pcnt_a, pcnt_b)
-    scnt_u = (scnt_a, scnt_b)
-    predsm_u = (predsm_a, predsm_b)
-    order_u = (order_a, order_b)
-    score_u = (score_a, score_b)
-    cpred_u = (cpred_a, cpred_b)
-    predw_u = (predw_a, predw_b)
-    path_u = (path_a, path_b)
-    gcnt_u = (gcnt_a, gcnt_b)
-    regs_u = (regs_a, regs_b)
-    minsucc_u = (minsucc_a, minsucc_b)
+    # group -- measured r5: zero speedup from pairing until the split)
+    grp = {}
+    for gi, name in enumerate(_SCRATCH_PER_WIN):
+        grp[name] = tuple(scr[gi * S + u] for u in range(S))
+    chw_v, chars_v, chw_s, cons_sm, sem = \
+        scr[len(_SCRATCH_PER_WIN) * S:]
+    preds_u = grp["preds"]
+    succs_u = grp["succs"]
+    ring_u = grp["ring"]
+    accs_u = grp["accs"]
+    arga_u = grp["arga"]
+    aligsm_u = grp["aligsm"]
+    predwv_u = grp["predwv"]
+    base_u = grp["base"]
+    anch_u = grp["anch"]
+    nseq_u = grp["nseq"]
+    nxt_u = grp["nxt"]
+    glast_u = grp["glast"]
+    bandq_u = grp["bandq"]
+    pcnt_u = grp["pcnt"]
+    scnt_u = grp["scnt"]
+    predsm_u = grp["predsm"]
+    predw_u = grp["predw"]
+    path_u = grp["path"]
+    gcnt_u = grp["gcnt"]
+    regs_u = grp["regs"]
+    minsucc_u = grp["minsucc"]
+    # consensus-phase arrays alias per-layer state that is DEAD by the
+    # time consensus runs (part of the r5 SMEM diet: 3 fewer v-sized
+    # SMEM arrays per window):
+    #   score  <- anch  (anchors are only read during merge)
+    #   cpred  <- bandq (band epochs are only read during DP/traceback)
+    #   order  <- glast (group-last is only read during merge)
+    score_u = anch_u
+    cpred_u = bandq_u
+    order_u = glast_u
 
     def stage_chw():
         """Copy the staged packed char*256+weight rows into SMEM: the
@@ -216,7 +268,7 @@ def _kernel(nlay_ref, bblen_ref,
         read is ~20 ns where each vector->scalar lane extraction costs
         a VPU sync -- the round-3 merge bottleneck.  The copy moves
         the whole (8, lp+256) staging block because DMA slices must be
-        8-sublane aligned; rows _S..7 are ballast."""
+        8-sublane aligned; rows S..7 are ballast."""
         cp = pltpu.make_async_copy(chw_v, chw_s, sem)
         cp.start()
         cp.wait()
@@ -233,6 +285,10 @@ def _kernel(nlay_ref, bblen_ref,
     iota_p = lax.broadcasted_iota(jnp.int32, (1, p), 1)
     iota_s = lax.broadcasted_iota(jnp.int32, (1, s_), 1)
     iota_a = lax.broadcasted_iota(jnp.int32, (1, a_), 1)
+    # pred-weight spill width: slots 0-7 live in SMEM (the hot,
+    # in-degree <= 8 case), slots 8..p-1 in a VMEM row per node
+    pw = max(p - 8, 1)
+    iota_pw = lax.broadcasted_iota(jnp.int32, (1, pw), 1)
     # path pack radix: entry = (node+2)*pkr + (spos+2); spos < lp and
     # node < v, so pkr must clear lp (the wrapper asserts the product
     # fits int32)
@@ -254,8 +310,8 @@ def _kernel(nlay_ref, bblen_ref,
 
     # ---- scratch bulk init (scratch persists across grid programs) --
     iota_v0 = lax.broadcasted_iota(jnp.int32, (v, 1), 0)
-    bblm_u = [jnp.minimum(bbl_u[u], v) for u in range(_S)]
-    for u in range(_S):
+    bblm_u = [jnp.minimum(bbl_u[u], v) for u in range(S)]
+    for u in range(S):
         # backbone chain adjacency, vectorized (one column store each)
         preds_u[u][:, :] = jnp.full((v, p), -1, jnp.int32)
         preds_u[u][:, 0:1] = jnp.where(
@@ -263,17 +319,11 @@ def _kernel(nlay_ref, bblen_ref,
         succs_u[u][:, :] = jnp.full((v, s_), -1, jnp.int32)
         succs_u[u][:, 0:1] = jnp.where(
             iota_v0 < bblm_u[u] - 1, iota_v0 + 1, -1)
-        # the pred-fold staging row: [0, wb) is overwritten per fold,
-        # the [wb, wb + N_SHIFT*q) tail stays packed--inf so a lagging
-        # pred's shifted window reads -inf beyond its band (rows are
-        # packed score*64 | code, see epilogue)
-        stage_u[u][:, :] = jnp.full((4, wb + _N_SHIFT * q),
-                                    _PNEG, jnp.int32)
     chw_v[:, :] = jnp.zeros((8, lp + 256), jnp.int32)
     chars_v[:, :] = jnp.zeros((8, lp + 256), jnp.int32)
 
     def init_nodes(j, _):
-        for u in range(_S):
+        for u in range(S):
             bandq_u[u][j] = jnp.int32(-1)
             gcnt_u[u][j] = jnp.int32(0)
         return 0
@@ -282,7 +332,7 @@ def _kernel(nlay_ref, bblen_ref,
 
     # regs: 0 fail, 1 head, 2 nodes_len, 3 n_seqs_incl, 4 rank_steps,
     # 6 best sink node, 7 best sink score, 8 nreal, 9 nbad, 10 target
-    for u in range(_S):
+    for u in range(S):
         regs_u[u][0] = jnp.int32(0)
         regs_u[u][1] = jnp.int32(0)
         regs_u[u][2] = bblm_u[u]
@@ -297,7 +347,7 @@ def _kernel(nlay_ref, bblen_ref,
     # racon_tpu/native/poa_graph.hpp add_alignment initial branch) ----
     # stage char*256+weight in VMEM (the DP band load windows into it)
     # and mirror it into SMEM (seed/merge read per position)
-    for u in range(_S):
+    for u in range(S):
         chw_v[u:u + 1, 0:lp] = seqs_ref[u, 0:1, :] * 256 \
             + wts_ref[u, 0:1, :]
     stage_chw()
@@ -330,17 +380,19 @@ def _kernel(nlay_ref, bblen_ref,
                 # only the data-dependent weight is per-node
                 # (pred-side only: consensus scores in-edges, so succ
                 # weights would be dead state)
-                predw_u[u][(j) * p + 0] = prev_w + w
+                predw_u[u][(j) * 8 + 0] = prev_w + w
         return jnp.where(act, w, prev_w)
 
     def seed(j, carry):
         ws = list(carry)
-        for u in range(_S):
+        for u in range(S):
             ws[u] = seed_one(u, j, ws[u], j < bblm_u[u])
         return tuple(ws)
 
-    lax.fori_loop(0, jnp.maximum(bblm_u[0], bblm_u[1]), seed,
-                  (jnp.int32(0),) * _S)
+    bblm_max = bblm_u[0]
+    for u in range(1, S):
+        bblm_max = jnp.maximum(bblm_max, bblm_u[u])
+    lax.fori_loop(0, bblm_max, seed, (jnp.int32(0),) * S)
 
     # ---- helpers shared by the merge step (u is a python int) -------
 
@@ -409,10 +461,17 @@ def _kernel(nlay_ref, bblen_ref,
         hit = lax.cond((found < 0) & (pc_ > 8), deep_search,
                        mirror_hit, 0)
 
-        @pl.when(hit < p)
+        @pl.when(hit < 8)
         def _():
-            hp = t * p + hit
+            hp = t * 8 + hit
             predw_u[u][hp] = predw_u[u][hp] + w
+
+        @pl.when((hit >= 8) & (hit < p))
+        def _():
+            # spilled slot (in-degree > 8, rare): weight row in VMEM
+            wrow = vload(predwv_u[u], t)
+            predwv_u[u][pl.ds(t, 1), :] = jnp.where(
+                iota_pw == hit - 8, wrow + w, wrow)
 
         @pl.when(hit >= p)
         def _():
@@ -430,13 +489,19 @@ def _kernel(nlay_ref, bblen_ref,
                                                   anch_u[u][t])
                 preds_u[u][pl.ds(t, 1), :] = jnp.where(
                     iota_p == pfree, nu, prow)
-                predw_u[u][(t) * p + 0 + pfree] = w
                 scnt_u[u][nu] = free + 1
                 pcnt_u[u][t] = pfree + 1
 
                 @pl.when(pfree < 8)
                 def _():
+                    predw_u[u][(t) * 8 + 0 + pfree] = w
                     predsm_u[u][(t) * 8 + 0 + pfree] = nu
+
+                @pl.when(pfree >= 8)
+                def _():
+                    wrow = vload(predwv_u[u], t)
+                    predwv_u[u][pl.ds(t, 1), :] = jnp.where(
+                        iota_pw == pfree - 8, w, wrow)
 
             @pl.when(jnp.logical_not(okk) & (regs_u[u][0] == 0))
             def _():
@@ -450,18 +515,22 @@ def _kernel(nlay_ref, bblen_ref,
 
     def layer(d, _):
         act_u = [(regs_u[u][0] == 0) & (d <= nlay_u[u])
-                 for u in range(_S)]
+                 for u in range(S)]
 
-        @pl.when(act_u[0] | act_u[1])
+        act_any = act_u[0]
+        for u in range(1, S):
+            act_any = act_any | act_u[u]
+
+        @pl.when(act_any)
         def _do_layer():
             # per-window layer metadata (meta rows exist for every
             # d < d1, so reads past a window's own nlay are safe and
             # their uses are act-gated)
-            begin_u = [meta_ref[u, d, 0] for u in range(_S)]
-            end_u = [meta_ref[u, d, 1] for u in range(_S)]
-            fsp_u = [meta_ref[u, d, 2] for u in range(_S)]
-            m_u = [meta_ref[u, d, 3] for u in range(_S)]
-            for u in range(_S):
+            begin_u = [meta_ref[u, d, 0] for u in range(S)]
+            end_u = [meta_ref[u, d, 1] for u in range(S)]
+            fsp_u = [meta_ref[u, d, 2] for u in range(S)]
+            m_u = [meta_ref[u, d, 3] for u in range(S)]
+            for u in range(S):
                 regs_u[u][3] = regs_u[u][3] + jnp.where(
                     act_u[u] & (m_u[u] > 0), 1, 0)
                 # stage chars (DP band loads) and char*256+weight
@@ -480,9 +549,9 @@ def _kernel(nlay_ref, bblen_ref,
             # monotone along the topo list, so a successor's band
             # never lags any predecessor's (the dq >= 0 invariant).
             end_eff_u = [jnp.where(fsp_u[u] > 0, _INF32 - 1, end_u[u])
-                         for u in range(_S)]
+                         for u in range(S)]
             smax_u = [(jnp.maximum(m_u[u] + 1 - wb, 0) + q - 1) // q
-                      for u in range(_S)]
+                      for u in range(S)]
             # q8 fixed-point band slope per subset rank: nr is the
             # list length for full-span layers (their subset is the
             # whole graph) and a backbone-density estimate for partial
@@ -490,7 +559,7 @@ def _kernel(nlay_ref, bblen_ref,
             # divide (nvis <= v, slope < 2^18 only when nr_est is 1
             # and m is at cap -- products stay inside int32)
             slope_u = []
-            for u in range(_S):
+            for u in range(S):
                 span = jnp.maximum(end_u[u] - begin_u[u], 1)
                 nr_est = jnp.where(
                     fsp_u[u] > 0, regs_u[u][2],
@@ -499,11 +568,12 @@ def _kernel(nlay_ref, bblen_ref,
                 slope_u.append((m_u[u] * 256)
                                // jnp.maximum(nr_est, 1))
                 regs_u[u][6] = jnp.int32(-1)    # best sink node
-                # sink-score floor: packed--inf rows unpack to -2^24,
-                # so the init must sit ABOVE that (else a sink whose
-                # end column only ever received propagated -inf would
-                # win the fold and the no-reachable-sink reject below
-                # could never fire) yet below any real score
+                # sink-score floor: unreachable rows hold clipped
+                # -inf (-2^24 after the pack clip), so the init must
+                # sit ABOVE that (else a sink whose end column only
+                # ever received propagated -inf would win the fold
+                # and the no-reachable-sink reject below could never
+                # fire) yet below any real score
                 # (|score| <= max|param| * (v + lp) << 2^22)
                 regs_u[u][7] = jnp.int32(-(1 << 22))
 
@@ -513,27 +583,31 @@ def _kernel(nlay_ref, bblen_ref,
                 valid = (t < cnt) & (pid >= 0) & ((be >> 8) == d)
                 return valid, jnp.where(valid, be & 255, 0)
 
-            def pred_fold(u, row, pid, valid, sqp, sq_r):
+            def pred_fold(u, pid, valid, sqp, sq_r):
                 """One predecessor's H row realigned to this rank's
                 band, in vert space (u[c] = H_pred[s_r + c]); the diag
                 view is u shifted by one, applied once per rank after
                 the fold since the shift commutes with the max.
 
-                The row is staged into the window's stage ref and re-read at
-                lane offset dq*q (128-aligned, so the dynamic slice is
-                free); the staging tail stays -inf, covering the
-                shifted window's overhang."""
+                dq (the band lag) is < _N_SHIFT quanta, so the
+                realignment is a SELECT over the 4 static left-shifted
+                views of the row -- pure register ops.  (The r4 design
+                staged the row into a scratch ref and re-read it at a
+                dynamic lane offset; that VMEM write->dynamic-read
+                round trip stalled the pipeline once per slot per
+                rank and dominated the kernel wall.)"""
                 dq = sq_r - sqp
                 ok = valid & (dq >= 0) & (dq < _N_SHIFT)
-                dqc = jnp.clip(dq, 0, _N_SHIFT - 1)
-                stage_u[u][row:row + 1, 0:wb] = ring_u[u][
-                    pl.ds(jnp.clip(pid, 0, v - 1), 1), :]
-                hvp = stage_u[u][row:row + 1,
-                                 pl.ds(pl.multiple_of(dqc * q, q),
-                                       wb)]
+                hvp = ring_u[u][pl.ds(jnp.clip(pid, 0, v - 1), 1), :]
                 # unpack the score (arithmetic >> 6 floors negatives
                 # correctly since the packed code is non-negative)
-                hv = (hvp >> 6).astype(jnp.float32)
+                h0 = (hvp >> 6).astype(jnp.float32)
+                hv = h0
+                for kq in range(1, _N_SHIFT):
+                    shk = jnp.pad(h0, ((0, 0), (0, kq * q)),
+                                  constant_values=negf)[:, kq * q:
+                                                        kq * q + wb]
+                    hv = jnp.where(dq == kq, shk, hv)
                 hv = jnp.where(ok, hv, negf)
                 # a predecessor whose band lags out of shift range
                 # cannot contribute; silently degrading would corrupt
@@ -583,13 +657,13 @@ def _kernel(nlay_ref, bblen_ref,
                 val3, sqp3 = slot_meta(u, pid3, cnt, 3)
                 vvb = s_r.astype(jnp.float32) * gapf
 
-                hv0, nv0, bad0 = pred_fold(u, 0, pid0, val0, sqp0,
+                hv0, nv0, bad0 = pred_fold(u, pid0, val0, sqp0,
                                            sq_r)
-                hv1, nv1, bad1 = pred_fold(u, 1, pid1, val1, sqp1,
+                hv1, nv1, bad1 = pred_fold(u, pid1, val1, sqp1,
                                            sq_r)
-                hv2, nv2, bad2 = pred_fold(u, 2, pid2, val2, sqp2,
+                hv2, nv2, bad2 = pred_fold(u, pid2, val2, sqp2,
                                            sq_r)
-                hv3, nv3, bad3 = pred_fold(u, 3, pid3, val3, sqp3,
+                hv3, nv3, bad3 = pred_fold(u, pid3, val3, sqp3,
                                            sq_r)
                 # first-slot-wins argmax tree (matches the former
                 # sequential strict-> update order exactly)
@@ -633,7 +707,7 @@ def _kernel(nlay_ref, bblen_ref,
                             jnp.where(iota_p == t, prow, 0),
                             axis=1, keepdims=True))
                         val, sqp = slot_meta(u, pid, cnt, t)
-                        hv, nv, bad = pred_fold(u, 0, pid, val, sqp,
+                        hv, nv, bad = pred_fold(u, pid, val, sqp,
                                                 sq_r)
                         acc_update(u, hv, t)
 
@@ -671,12 +745,13 @@ def _kernel(nlay_ref, bblen_ref,
                                constant_values=negf)[:, :wb]
                 t_best = jnp.maximum(dmax, vmax)
                 x = t_best - colsg
-                sh = 1
-                while sh < wb:
-                    x = jnp.maximum(
-                        x, jnp.pad(x, ((0, 0), (sh, 0)),
-                                   constant_values=negf)[:, :wb])
-                    sh <<= 1
+                if not (prof & 2):   # profiling: skip the gap chain
+                    sh = 1
+                    while sh < wb:
+                        x = jnp.maximum(
+                            x, jnp.pad(x, ((0, 0), (sh, 0)),
+                                       constant_values=negf)[:, :wb])
+                        sh <<= 1
                 hr = x + colsg
                 argd = jnp.pad(argu, ((0, 0), (1, 0)),
                                constant_values=0)[:, :wb]
@@ -727,28 +802,36 @@ def _kernel(nlay_ref, bblen_ref,
                                 regs_u[u][6] = st["node"]
 
             def dp_cond(c):
-                return (c[0] >= 0) | (c[2] >= 0)
+                alive = c[0] >= 0
+                for u in range(1, S):
+                    alive = alive | (c[2 * u] >= 0)
+                return alive
 
             def dp_body(c):
-                n0, v0, n1, v1 = c
-                st0 = dp_pre(0, n0, v0)
-                st1 = dp_pre(1, n1, v1)
-                dp_deep(0, st0)
-                dp_deep(1, st1)
-                e0 = dp_epi(0, st0)
-                e1 = dp_epi(1, st1)
-                dp_store(0, st0, *e0)
-                dp_store(1, st1, *e1)
-                return st0["nxt"], st0["nvis2"], st1["nxt"], \
-                    st1["nvis2"]
+                # phase-by-phase across ALL windows: each phase's S
+                # bodies are emitted back to back in one straight-line
+                # region so the VLIW scheduler can interleave the
+                # independent chains (the whole point of grouping)
+                sts = [dp_pre(u, c[2 * u], c[2 * u + 1])
+                       for u in range(S)]
+                for u in range(S):
+                    dp_deep(u, sts[u])
+                es = [dp_epi(u, sts[u]) for u in range(S)]
+                for u in range(S):
+                    dp_store(u, sts[u], *es[u])
+                out = []
+                for u in range(S):
+                    out.extend((sts[u]["nxt"], sts[u]["nvis2"]))
+                return tuple(out)
 
             head_u = [jnp.where(act_u[u], regs_u[u][1], -1)
-                      for u in range(_S)]
-            _, nvis0, _, nvis1 = lax.while_loop(
-                dp_cond, dp_body,
-                (head_u[0], jnp.int32(0), head_u[1], jnp.int32(0)))
-            nvis_u = [nvis0, nvis1]
-            for u in range(_S):
+                      for u in range(S)]
+            init = []
+            for u in range(S):
+                init.extend((head_u[u], jnp.int32(0)))
+            fin = lax.while_loop(dp_cond, dp_body, tuple(init))
+            nvis_u = [fin[2 * u + 1] for u in range(S)]
+            for u in range(S):
                 regs_u[u][4] = regs_u[u][4] + nvis_u[u]
 
                 # no subset sink landed within band reach of the
@@ -766,7 +849,9 @@ def _kernel(nlay_ref, bblen_ref,
             # windows' steps interleave so the per-step extract
             # latencies overlap.
             tact_u = [act_u[u] & (regs_u[u][0] == 0)
-                      for u in range(_S)]
+                      for u in range(S)]
+            if prof & 1:   # profiling: skip traceback+merge
+                tact_u = [jnp.bool_(False) for _ in range(S)]
 
             def tb_pre(u, node, jj, step, live):
                 """Pure step compute (incl. the per-step direction
@@ -820,31 +905,34 @@ def _kernel(nlay_ref, bblen_ref,
                         jnp.where(live, nj, jj),
                         step + jnp.where(live, 1, 0))
 
+            def tb_live(c, u):
+                n, j, sc = c[3 * u], c[3 * u + 1], c[3 * u + 2]
+                return ((n >= 0) | (j > 0)) & (sc < tape)
+
             def tb_cond(c):
-                n0, j0, s0c, n1, j1, s1c = c
-                live0 = ((n0 >= 0) | (j0 > 0)) & (s0c < tape)
-                live1 = ((n1 >= 0) | (j1 > 0)) & (s1c < tape)
-                return live0 | live1
+                alive = tb_live(c, 0)
+                for u in range(1, S):
+                    alive = alive | tb_live(c, u)
+                return alive
 
             def tb_body(c):
-                n0, j0, s0c, n1, j1, s1c = c
-                live0 = ((n0 >= 0) | (j0 > 0)) & (s0c < tape)
-                live1 = ((n1 >= 0) | (j1 > 0)) & (s1c < tape)
-                st0 = tb_pre(0, n0, j0, s0c, live0)
-                st1 = tb_pre(1, n1, j1, s1c, live1)
-                n0, j0, s0c = tb_fin(0, st0)
-                n1, j1, s1c = tb_fin(1, st1)
-                return n0, j0, s0c, n1, j1, s1c
+                sts = [tb_pre(u, c[3 * u], c[3 * u + 1], c[3 * u + 2],
+                              tb_live(c, u))
+                       for u in range(S)]
+                out = []
+                for u in range(S):
+                    out.extend(tb_fin(u, sts[u]))
+                return tuple(out)
 
             tb0 = [jnp.where(tact_u[u], regs_u[u][6], -1)
-                   for u in range(_S)]
-            tbm = [jnp.where(tact_u[u], m_u[u], 0) for u in range(_S)]
-            _, _, plen0, _, _, plen1 = lax.while_loop(
-                tb_cond, tb_body,
-                (tb0[0], tbm[0], jnp.int32(0),
-                 tb0[1], tbm[1], jnp.int32(0)))
-            plen_u = [plen0, plen1]
-            for u in range(_S):
+                   for u in range(S)]
+            tbm = [jnp.where(tact_u[u], m_u[u], 0) for u in range(S)]
+            init_tb = []
+            for u in range(S):
+                init_tb.extend((tb0[u], tbm[u], jnp.int32(0)))
+            fin_tb = lax.while_loop(tb_cond, tb_body, tuple(init_tb))
+            plen_u = [fin_tb[3 * u + 2] for u in range(S)]
+            for u in range(S):
                 @pl.when(tact_u[u] & (plen_u[u] >= tape))
                 def _(u=u):
                     regs_u[u][0] = jnp.int32(FAIL_PATH)
@@ -854,9 +942,9 @@ def _kernel(nlay_ref, bblen_ref,
             # come from the rows staged at layer start.  Joint loop:
             # the two windows' scalar chase chains interleave.
             mact_u = [act_u[u] & (regs_u[u][0] == 0)
-                      for u in range(_S)]
+                      for u in range(S)]
             mlen_u = [jnp.where(mact_u[u], plen_u[u], 0)
-                      for u in range(_S)]
+                      for u in range(S)]
 
             def m_pre(u, t, prev, prev_w):
                 """Pure step decode (the scalar chase chain); both
@@ -979,22 +1067,27 @@ def _kernel(nlay_ref, bblen_ref,
                         jnp.where(has, w, prev_w))
 
             def mbody(t, carry):
-                p0, w0, p1, w1 = carry
-                st0 = m_pre(0, t, p0, w0)
-                st1 = m_pre(1, t, p1, w1)
-                p0, w0 = m_apply(0, st0)
-                p1, w1 = m_apply(1, st1)
-                return p0, w0, p1, w1
+                sts = [m_pre(u, t, carry[2 * u], carry[2 * u + 1])
+                       for u in range(S)]
+                out = []
+                for u in range(S):
+                    out.extend(m_apply(u, sts[u]))
+                return tuple(out)
 
-            lax.fori_loop(0, jnp.maximum(mlen_u[0], mlen_u[1]), mbody,
-                          (jnp.int32(-1), jnp.int32(0),
-                           jnp.int32(-1), jnp.int32(0)))
+            mlen_max = mlen_u[0]
+            for u in range(1, S):
+                mlen_max = jnp.maximum(mlen_max, mlen_u[u])
+            lax.fori_loop(0, mlen_max, mbody,
+                          (jnp.int32(-1), jnp.int32(0)) * S)
         return 0
 
-    lax.fori_loop(1, jnp.maximum(nlay_u[0], nlay_u[1]) + 1, layer, 0)
+    nlay_max = nlay_u[0]
+    for u in range(1, S):
+        nlay_max = jnp.maximum(nlay_max, nlay_u[u])
+    lax.fori_loop(1, nlay_max + 1, layer, 0)
 
     # ---- consensus: heaviest bundle over each full graph ------------
-    for u in range(_S):
+    for u in range(S):
         fail = regs_u[u][0]
         for r in range(8):
             mout_ref[u, r, 0] = jnp.int32(0)
@@ -1026,20 +1119,27 @@ def _kernel(nlay_ref, bblen_ref,
 
                 def pick(t, carry):
                     bu, bw = carry
-                    pidm = predsm_u[u][(node) * 8 + 0
-                                    + jnp.clip(t, 0, 7)]
+                    tc = jnp.clip(t, 0, 7)
+                    pidm = predsm_u[u][(node) * 8 + 0 + tc]
+                    wm = predw_u[u][(node) * 8 + 0 + tc]
 
                     def deep(_):
+                        # spilled slot: id from the VMEM row, weight
+                        # from the VMEM spill row
                         prow = vload(preds_u[u], node)
-                        return e11(jnp.sum(
+                        wrow = vload(predwv_u[u], node)
+                        pid = e11(jnp.sum(
                             jnp.where(iota_p == t, prow, 0), axis=1,
                             keepdims=True))
+                        wv = e11(jnp.sum(
+                            jnp.where(iota_pw == t - 8, wrow, 0),
+                            axis=1, keepdims=True))
+                        return pid, wv
 
                     def keep(_):
-                        return pidm
+                        return pidm, wm
 
-                    pid = lax.cond(t >= 8, deep, keep, 0)
-                    w = predw_u[u][(node) * p + 0 + t]
+                    pid, w = lax.cond(t >= 8, deep, keep, 0)
                     sc = score_u[u][jnp.maximum(pid, 0)]
                     bsc = score_u[u][jnp.maximum(bu, 0)]
                     tk = (pid >= 0) & ((w > bw) |
@@ -1125,17 +1225,24 @@ def _kernel(nlay_ref, bblen_ref,
 
 @functools.partial(
     jax.jit,
-    static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18))
+    static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18,
+                    19, 20))
 def _poa_full(seqs, wts, meta, nlay, bblen,
               v: int, lp: int, d1: int, p: int, s_: int, a_: int,
               k: int, wb: int, match: int, mismatch: int, gap: int,
-              wtype: int, trim: int, interpret: bool = False):
+              wtype: int, trim: int, s_win: int = 0,
+              interpret: bool = False, prof: int = 0):
     """seqs/wts: [B, D1, LP] uint8 (d=0 = backbone), meta: [B, D1, 8]
     int32 (begin, end, full_span, slen, ...), nlay/bblen: [B] int32.
-    B must be a multiple of the per-program pair factor (_S == 2).
+    B must be a multiple of the windows-per-program factor ``s_win``
+    (0 = pick the largest that fits).
     Returns (cons [B, V, 1] int32, mout [B, 8, 1] int32)."""
     b = seqs.shape[0]
-    assert b % _S == 0, f"batch {b} not a multiple of pair factor {_S}"
+    if not s_win:
+        s_win = pick_windows_per_program(v, lp, d1, p, s_, a_, wb)
+    assert s_win > 0, "shape does not fit the flagship kernel"
+    assert b % s_win == 0, \
+        f"batch {b} not a multiple of group factor {s_win}"
     pkr = 1
     while pkr < lp + 8:
         pkr <<= 1
@@ -1145,66 +1252,82 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
 
     kern = functools.partial(
         _kernel, v=v, lp=lp, d1=d1, p=p, s_=s_, a_=a_, k=k, wb=wb,
-        match=match, mismatch=mismatch, gap=gap,
-        wtype=wtype, trim=trim)
+        s_win=s_win, match=match, mismatch=mismatch, gap=gap,
+        wtype=wtype, trim=trim, prof=prof)
+    pw = max(p - 8, 1)
+    # one ref PER WINDOW so the scheduler can prove the interleaved
+    # walks never alias (see _kernel); order must match
+    # _SCRATCH_PER_WIN
+    per_win = {
+        "preds": pltpu.VMEM((v, p), jnp.int32),
+        "succs": pltpu.VMEM((v, s_), jnp.int32),
+        "ring": pltpu.VMEM((v, wb), jnp.int32),   # packed score|code
+        "accs": pltpu.VMEM((1, wb), jnp.float32),
+        "arga": pltpu.VMEM((1, wb), jnp.int32),
+        "aligsm": pltpu.VMEM((v, a_), jnp.int32),  # aligned groups
+        "predwv": pltpu.VMEM((v, pw), jnp.int32),  # pred-w spill 8+
+        "base": pltpu.SMEM((v,), jnp.int32),
+        "anch": pltpu.SMEM((v,), jnp.int32),   # aliased: cons score
+        "nseq": pltpu.SMEM((v,), jnp.int32),
+        "nxt": pltpu.SMEM((v,), jnp.int32),
+        "glast": pltpu.SMEM((v,), jnp.int32),  # aliased: cons order
+        "bandq": pltpu.SMEM((v,), jnp.int32),  # aliased: cons pred
+        "pcnt": pltpu.SMEM((v,), jnp.int32),
+        "scnt": pltpu.SMEM((v,), jnp.int32),
+        "predsm": pltpu.SMEM((8 * v,), jnp.int32),  # pred id mirror
+        "predw": pltpu.SMEM((8 * v,), jnp.int32),   # pred w slots 0-7
+        "path": pltpu.SMEM((v + lp,), jnp.int32),
+        "gcnt": pltpu.SMEM((v,), jnp.int32),   # aligned count
+        "regs": pltpu.SMEM((_NREG,), jnp.int32),
+        "minsucc": pltpu.SMEM((v,), jnp.int32),
+    }
+    assert set(per_win) == set(_SCRATCH_PER_WIN)
+    scratch = []
+    for name in _SCRATCH_PER_WIN:
+        scratch.extend([per_win[name]] * s_win)
+    scratch += [
+        pltpu.VMEM((8, lp + 256), jnp.int32),   # staged chr*w
+        pltpu.VMEM((8, lp + 256), jnp.int32),   # staged chars
+        pltpu.SMEM((8, lp + 256), jnp.int32),   # chw mirror
+        pltpu.SMEM((s_win, v // 128, 128), jnp.int32),  # consensus
+        pltpu.SemaphoreType.DMA,                # staging sem
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b // _S,),
+        grid=(b // s_win,),
         in_specs=[
-            pl.BlockSpec((_S, d1, lp), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((s_win, d1, lp), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((_S, d1, lp), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((s_win, d1, lp), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((_S, d1, 8), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((s_win, d1, 8), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.SMEM),
         ],
         out_specs=(
-            pl.BlockSpec((_S, v // 128, 128), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((s_win, v // 128, 128),
+                         lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((_S, 8, 1), lambda i, *_: (i, 0, 0),
+            pl.BlockSpec((s_win, 8, 1), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.SMEM),
         ),
-        scratch_shapes=(
-            # one ref PER WINDOW so the scheduler can prove the two
-            # interleaved walks never alias (see _kernel)
-            [pltpu.VMEM((v, p), jnp.int32)] * _S      # preds
-            + [pltpu.VMEM((v, s_), jnp.int32)] * _S   # succs
-            + [pltpu.VMEM((4, wb + _N_SHIFT * 128), jnp.int32)] * _S
-            + [pltpu.VMEM((v, wb), jnp.int32)] * _S   # packed rows
-            + [pltpu.VMEM((1, wb), jnp.float32)] * _S  # accs
-            + [pltpu.VMEM((1, wb), jnp.int32)] * _S   # arga
-            + [pltpu.VMEM((8, lp + 256), jnp.int32)]  # staged chr*w
-            + [pltpu.VMEM((8, lp + 256), jnp.int32)]  # staged chars
-            + [pltpu.VMEM((v, a_), jnp.int32)] * _S   # aligned groups
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # base
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # anchor
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # nseqs
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # next
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # group-last
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # band epoch|sq
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # pred count
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # succ count
-            + [pltpu.SMEM((8 * v,), jnp.int32)] * _S  # pred id mirror
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # order
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # cons score
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # cons pred
-            + [pltpu.SMEM((v * p,), jnp.int32)] * _S  # pred weights
-            + [pltpu.SMEM((v + lp,), jnp.int32)] * _S  # packed paths
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # aligned count
-            + [pltpu.SMEM((_NREG,), jnp.int32)] * _S  # regs
-            + [pltpu.SMEM((v,), jnp.int32)] * _S      # min succ
-            + [pltpu.SMEM((8, lp + 256), jnp.int32)]  # chw mirror
-            + [pltpu.SMEM((_S, v // 128, 128), jnp.int32)]  # consensus
-            + [pltpu.SemaphoreType.DMA]               # staging sem
-        ),
+        scratch_shapes=tuple(scratch),
     )
     assert v % 128 == 0, "node cap must be lane-aligned"
+    kwargs = {}
+    if not interpret:
+        # the compiler's stack temporaries for S interleaved
+        # straight-line window bodies exceed Mosaic's default 16M
+        # scoped-vmem limit from S=3 up; v5e has 128M of VMEM, so
+        # grant the kernel a 64M scope (declared scratch + temps)
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=64 << 20)
     return pl.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct((b, v // 128, 128), jnp.int32),
                    jax.ShapeDtypeStruct((b, 8, 1), jnp.int32)),
         interpret=interpret,
+        **kwargs,
     )(nlay, bblen, seqs_l, wts_l, meta)
 
 
@@ -1212,10 +1335,11 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
     jax.jit,
     static_argnames=("mesh", "v", "lp", "d1", "p", "s_", "a_", "k",
                      "wb", "match", "mismatch", "gap", "wtype", "trim",
-                     "interpret"))
+                     "s_win", "interpret"))
 def _poa_full_sharded(seqs, wts, meta, nlay, bblen, *, mesh,
                       v, lp, d1, p, s_, a_, k, wb,
-                      match, mismatch, gap, wtype, trim, interpret):
+                      match, mismatch, gap, wtype, trim, s_win,
+                      interpret):
     """The same kernel sharded over the mesh batch axis with shard_map:
     one compile, XLA places one grid per device, no collectives — the
     TPU-native analog of the reference's fully independent per-device
@@ -1225,7 +1349,8 @@ def _poa_full_sharded(seqs, wts, meta, nlay, bblen, *, mesh,
     def shard_fn(seqs, wts, meta, nlay, bblen):
         return _poa_full(seqs, wts, meta, nlay, bblen,
                          v, lp, d1, p, s_, a_, k, wb,
-                         match, mismatch, gap, wtype, trim, interpret)
+                         match, mismatch, gap, wtype, trim, s_win,
+                         interpret)
 
     return shard_batch_map(shard_fn, mesh, 5, 2)(
         seqs, wts, meta, nlay, bblen)
@@ -1268,13 +1393,15 @@ def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
 
     With a multi-device ``mesh`` the batch axis is sharded across the
     devices (callers pad the batch; this pads further to a mesh-and-
-    pair multiple with inert 1-base windows)."""
+    group multiple with inert 1-base windows)."""
     from racon_tpu.parallel.mesh_utils import interpret_mode
 
     n_dev = len(mesh.devices) if mesh is not None else 1
     interp = interpret_mode()
     b0 = seqs.shape[0]
-    mult = _S * n_dev
+    s_win = pick_windows_per_program(v, lp, d1, p, s, a, wb)
+    assert s_win > 0, "shape does not fit the flagship kernel"
+    mult = s_win * n_dev
     if b0 % mult:
         seqs, wts, meta, nlay, bblen = _pad_pairs(
             seqs, wts, meta, nlay, bblen, mult)
@@ -1284,12 +1411,12 @@ def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
             jnp.asarray(nlay), jnp.asarray(bblen), mesh=mesh,
             v=v, lp=lp, d1=d1, p=p, s_=s, a_=a, k=k, wb=wb,
             match=match, mismatch=mismatch, gap=gap, wtype=wtype,
-            trim=trim, interpret=interp)
+            trim=trim, s_win=s_win, interpret=interp)
     else:
         from racon_tpu.utils import aot_shelf
 
         statics = (v, lp, d1, p, s, a, k, wb, match, mismatch, gap,
-                   wtype, trim, interp)
+                   wtype, trim, s_win, interp)
 
         def build(se, wt, me, nl, bb):
             return _poa_full(se, wt, me, nl, bb, *statics)

@@ -28,6 +28,34 @@ from racon_tpu.core.polisher import Polisher, PolisherType
 from racon_tpu.core.window import WindowType
 
 
+_PREWARM_THREADS: list = []
+
+
+def _spawn_prewarm(target, name: str) -> None:
+    """Start a background trace/compile thread and register it for the
+    exit join: a daemon thread torn down mid-C++-call aborts the
+    process (measured r5: 'FATAL: exception not rethrown' whenever a
+    polish exits before a prewarm compile finishes), so atexit joins
+    them -- by then the work is idempotent shelf population."""
+    import threading
+
+    t = threading.Thread(target=target, daemon=True, name=name)
+    _PREWARM_THREADS.append(t)
+    t.start()
+
+
+def join_prewarm_threads(timeout: float = None) -> None:
+    for t in list(_PREWARM_THREADS):
+        t.join(timeout)
+        if not t.is_alive():
+            _PREWARM_THREADS.remove(t)
+
+
+import atexit as _atexit
+
+_atexit.register(join_prewarm_threads)
+
+
 def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, default))
 
@@ -447,7 +475,6 @@ class TPUPolisher(Polisher):
                    n_dev * _env_int("RACON_TPU_POA_MEGABATCH", 256),
                    max(8, int(0.55 * n_win)))
         b_pad = max(8, pow2_at_least(take, 8))
-        b_pad += (-b_pad) % n_dev
 
         wtype = self.window_type.value
         mesh = self.mesh
@@ -456,15 +483,18 @@ class TPUPolisher(Polisher):
             for d1 in d1s:
                 try:
                     if poa_pallas.fits(vcap, lcap, d1, 16, 16, 8, wb):
+                        # predict the post-pad batch dispatch will use
+                        # (multiple of windows-per-program x devices)
+                        bp = poa_pallas.padded_batch(
+                            b_pad, n_dev, vcap, lcap, d1, wb=wb)
                         poa_pallas.prewarm(
-                            b_pad, d1, v=vcap, lp=lcap, wb=wb,
+                            bp, d1, v=vcap, lp=lcap, wb=wb,
                             match=self.match, mismatch=self.mismatch,
                             gap=self.gap, wtype=wtype, mesh=mesh)
                 except Exception:
                     return  # prewarm is best-effort only
 
-        threading.Thread(target=work, daemon=True,
-                         name="racon-poa-prewarm").start()
+        _spawn_prewarm(work, "racon-poa-prewarm")
 
     def find_overlap_breaking_points(self, overlaps: List[Overlap]) -> None:
         if self.tpu_aligner_batches > 0:
@@ -807,18 +837,39 @@ class TPUPolisher(Polisher):
             max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
             n_cert = 0
             still = set()
-            for c0 in range(0, len(idx), max_b):
-                sub = idx[c0:c0 + max_b]
-                import time as _time
-                t1 = _time.monotonic()
-                moves, lens, dists = align_pallas.align_batch(
+            import time as _time
+
+            # two-deep pipeline: dispatch chunk k+1 before collecting
+            # chunk k, so the host-side decode of one chunk (and the
+            # tunnel's collect round trip) hides under the next
+            # chunk's device compute.  Two chunks are in flight, so
+            # each must fit HALF the memory budget for the documented
+            # footprint bound to keep holding
+            if len(idx) > max_b:
+                max_b = max(8 * len(self.mesh.devices),
+                            max_b // 2)
+            chunks = [idx[c0:c0 + max_b]
+                      for c0 in range(0, len(idx), max_b)]
+
+            def dispatch(sub):
+                return align_pallas.align_dispatch(
                     [queries[i] for i in sub],
                     [targets[i] for i in sub],
                     bd, bd, wb, mesh=self.mesh)
+
+            pending_c = dispatch(chunks[0])
+            t_mark = _time.monotonic()
+            for ci, sub in enumerate(chunks):
+                nxt = dispatch(chunks[ci + 1]) \
+                    if ci + 1 < len(chunks) else None
+                moves, lens, dists = pending_c()
+                pending_c = nxt
                 if hasattr(self, "_align_disp"):
+                    now = _time.monotonic()
                     self._align_disp.append(
-                        (wb, _time.monotonic() - t1,
+                        (wb, now - t_mark,
                          float(sum(len(queries[i]) for i in sub))))
+                    t_mark = now
                 self.align_cells += sum(len(queries[i])
                                         for i in sub) * wb
                 for k, i in enumerate(sub):
@@ -836,12 +887,19 @@ class TPUPolisher(Polisher):
                        if i in still or i not in idx_set]
             # mispredicted starting rungs double-pay the kernel; the
             # counter keeps that visible (bench prints it)
-            self.align_retry_counts[wb] = \
-                self.align_retry_counts.get(wb, 0) + len(still)
+            if wb != rungs[-1]:
+                # only failures with a WIDER rung left are retries (a
+                # misprediction double-pays the kernel); final-rung
+                # failures are permanent CPU fall-throughs and would
+                # otherwise masquerade as predictor error
+                self.align_retry_counts[wb] = \
+                    self.align_retry_counts.get(wb, 0) + len(still)
+            tag = (f", {len(still)} "
+                   + ("retries" if wb != rungs[-1] else "cpu")
+                   if still else "")
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::align] device-aligned "
-                f"{n_cert}/{len(idx)} overlaps (band {wb}"
-                + (f", {len(still)} retries" if still else "") + ")")
+                f"{n_cert}/{len(idx)} overlaps (band {wb}{tag})")
         # survivors lack a CIGAR and take the CPU fall-through
         # (the reference's exceeded_max_alignment_difference skip)
 
@@ -895,8 +953,7 @@ class TPUPolisher(Polisher):
                 except Exception:
                     return
 
-        threading.Thread(target=work, daemon=True,
-                         name="racon-align-prewarm").start()
+        _spawn_prewarm(work, "racon-align-prewarm")
 
     def _align_chunk(self, chunk: List[Overlap], blq: int, blt: int,
                      n_dev: int) -> None:
