@@ -146,15 +146,24 @@ def main():
         # cold run pays one-time XLA compiles (persisted to the
         # compilation cache); the warm run is the steady-state number a
         # long polish sees -- the reference's CUDA kernels are compiled
-        # at build time so its runs are always "warm"
+        # at build time so its runs are always "warm".  On a fresh
+        # machine the cold run also stores generation-1 calibration
+        # rates and the settle run below refines+freezes them
+        # (racon_tpu/utils/calibrate.py), so the determinism-checked
+        # warm runs all see the same frozen split.
         cold_wall, cold_out, _ = run_polish(tpu_poa_batches=1,
                                             tpu_aligner_batches=1)
         log(f"[bench] TPU path (cold, incl. compiles): {cold_wall:.2f}s")
+        settle_wall, _, _ = run_polish(tpu_poa_batches=1,
+                                       tpu_aligner_batches=1)
+        log(f"[bench] TPU path (calibration settle): "
+            f"{settle_wall:.2f}s")
         accel_wall, accel_out, pol = run_polish(tpu_poa_batches=1,
                                                 tpu_aligner_batches=1)
         # more warm samples: the tunneled host shows +-20% run noise
         # (transfer latency jitter), so the headline takes the fastest
-        # steady-state run; all runs must stay byte-identical
+        # steady-state run; all post-freeze runs must stay
+        # byte-identical
         warm_outs = [accel_out]
         for _ in range(2):
             w2, o2, p2 = run_polish(tpu_poa_batches=1,
@@ -176,13 +185,18 @@ def main():
             f"rung retries {retries}")
         log(f"[bench] stage device_poa: {poa_s:.2f}s, "
             f"{poa_cps / 1e9:.2f} Gcells/s (band cells)")
-        # run-to-run determinism: both TPU runs must emit identical
-        # bytes (the analog of the reference's byte-identical golden
-        # diff, ci/gpu/cuda_test.sh:33)
+        # run-to-run determinism: every post-freeze TPU run must emit
+        # identical bytes (the analog of the reference's
+        # byte-identical golden diff, ci/gpu/cuda_test.sh:33).  The
+        # cold/settle runs may legitimately differ on a FRESH machine
+        # (they run under pre-freeze calibration generations); on a
+        # calibrated or env-pinned machine they match too, which the
+        # byte-exact CI golden lane asserts separately.
+        ref_out = warm_outs[0]
         deterministic = all(
-            len(cold_out) == len(o) and all(
-                a.data == b.data for a, b in zip(cold_out, o))
-            for o in warm_outs)
+            len(ref_out) == len(o) and all(
+                a.data == b.data for a, b in zip(ref_out, o))
+            for o in warm_outs[1:])
         log(f"[bench] TPU path deterministic across runs: "
             f"{deterministic}")
         extra = {
@@ -212,7 +226,7 @@ def main():
         # AND both distances go on record.  Isolated try: a
         # banded-only failure must not discard the results above.
         try:
-            if _budget_left(150, "w=1000 default/banded legs"):
+            if _budget_left(60, "w=1000 default/banded legs"):
                 w1k_wall, w1k_out, _ = run_polish(
                     tpu_poa_batches=1, tpu_aligner_batches=1,
                     window_length=1000)
@@ -281,7 +295,7 @@ def scale_bench():
     utilization).  Disable with RACON_TPU_BENCH_SCALE=0."""
     if os.environ.get("RACON_TPU_BENCH_SCALE", "1") == "0":
         return {}
-    if not _budget_left(120, "scale legs"):
+    if not _budget_left(90, "scale legs"):
         return {}
     import tempfile
 
@@ -403,7 +417,7 @@ def mega_bench():
         "mega", "mega (4.6Mb, 30x synthetic)",
         dict(genome_len=4_600_000, coverage=30, read_len=10_000,
              seed=11),
-        380, 660, "RACON_TPU_BENCH_MEGA")
+        380, 900, "RACON_TPU_BENCH_MEGA")
 
 
 def mega_ont_bench():
@@ -420,7 +434,7 @@ def mega_ont_bench():
         "mega_ont", "mega_ont (2.3Mb, 30x ONT model)",
         dict(genome_len=2_300_000, coverage=30, read_len=10_000,
              seed=13, ont=True),
-        420, 330, "RACON_TPU_BENCH_MEGA_ONT")
+        260, 500, "RACON_TPU_BENCH_MEGA_ONT")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Build a distributable wheel -- the analog of the reference's CPack
+# deb/rpm packaging step (reference: CMakeLists.txt:143-161 packages
+# the racon binary; meson.build:50-75 stamps the git-derived version).
+# The wheel ships the native engine sources + Makefile (pyproject
+# package-data), so an installed package rebuilds the CPU engine on
+# first use, and racon_tpu/__init__.py stamps __version__ from git
+# when building from a checkout.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+out="${1:-dist}"
+python -m pip wheel --no-deps --no-build-isolation -w "$out" . \
+    2>&1 | tail -2
+ls -l "$out"/racon_tpu-*.whl

@@ -52,7 +52,10 @@ cmp /tmp/racon_tpu_ci_1.fasta tests/golden/sample_tpu.fasta
 python ci/tpu/goldens.py --check
 
 # pytest on real hardware: the kernel suites incl. the on-TPU-only
-# tests (the full platform-independent suite runs in ci/cpu)
+# tests (the full platform-independent suite runs in ci/cpu), plus
+# the device-path golden matrix (the analog of the reference's CUDA
+# variants of every e2e golden, test/racon_test.cpp:292-496)
 RACON_TPU_TEST_PLATFORM=tpu python -m pytest -q -x \
-    tests/test_align_pallas.py tests/test_poa_full_device.py
+    tests/test_align_pallas.py tests/test_poa_full_device.py \
+    tests/test_tpu_golden_matrix.py
 echo "TPU CI PASS"

@@ -88,6 +88,7 @@ class Polisher:
         self.sequences: List[Sequence] = []
         self.windows: List[Window] = []
         self.targets_coverages: List[int] = []
+        self._owned_targets = None   # multi-host target mask
         self.dummy_quality = b"!" * window_length
         self.engine = cpu.PoaEngine(match, mismatch, gap)
         self.logger = Logger()
@@ -110,6 +111,23 @@ class Polisher:
         targets_size = len(self.sequences)
         if targets_size == 0:
             raise InvalidInputError("empty target sequences set!")
+
+        # multi-host scale-out: under jax.distributed each rank owns a
+        # deterministic contiguous slice of the targets, builds
+        # windows only for those, and emits only those (the wrapper
+        # --split flow, cross-host; racon_tpu/parallel/multihost.py).
+        # Ownership is a MASK, not a slice: every id mapping (MHAP's
+        # order-based ids included) must see the full target set.
+        from racon_tpu.parallel import multihost
+        nproc, rank = multihost.maybe_initialize()
+        self._owned_targets = None
+        if nproc > 1:
+            sl = multihost.target_slice(targets_size, nproc, rank)
+            self._owned_targets = [sl.start <= i < sl.stop
+                                   for i in range(targets_size)]
+            self.logger.log(
+                f"[racon_tpu::Polisher::initialize] multi-host rank "
+                f"{rank}/{nproc}: targets [{sl.start}, {sl.stop})")
 
         name_to_id: Dict[str, int] = {}
         id_to_id: Dict[int, int] = {}
@@ -181,7 +199,10 @@ class Polisher:
 
         overlaps = self._load_overlaps(name_to_id, id_to_id, has_data,
                                        has_reverse_data)
-        if not overlaps:
+        # a multi-host rank may legitimately own zero overlaps (its
+        # targets drew none); only single-process runs treat an empty
+        # set as invalid input
+        if not overlaps and self._owned_targets is None:
             raise InvalidInputError("empty overlap set!")
 
         self.logger.log("[racon_tpu::Polisher::initialize] loaded overlaps")
@@ -247,6 +268,17 @@ class Polisher:
             for i in range(l, c):
                 if overlaps[i] is None:
                     continue
+                if self._owned_targets is not None and \
+                        not self._owned_targets[overlaps[i].t_id]:
+                    # multi-host: another rank owns this target.  The
+                    # drop must come AFTER remove_invalid (the longest
+                    # -per-query winner is chosen over ALL targets,
+                    # matching single-process output) but BEFORE the
+                    # flag marking, so this rank never materializes
+                    # reverse complements for reads whose overlaps
+                    # all belong to other ranks
+                    overlaps[i] = None
+                    continue
                 if overlaps[i].strand:
                     has_reverse_data[overlaps[i].q_id] = True
                 else:
@@ -301,6 +333,12 @@ class Polisher:
                        overlaps: List[Overlap]) -> None:
         id_to_first_window_id = [0] * (targets_size + 1)
         for i in range(targets_size):
+            if self._owned_targets is not None \
+                    and not self._owned_targets[i]:
+                # multi-host: another rank emits this target; no
+                # windows means polish() skips it entirely
+                id_to_first_window_id[i + 1] = id_to_first_window_id[i]
+                continue
             data = self.sequences[i].data
             quality = self.sequences[i].quality
             k = 0

@@ -49,8 +49,13 @@ def shard_batch_map(fn, mesh: Mesh, n_in: int, n_out: int):
 
 
 def default_mesh(max_devices: Optional[int] = None) -> Mesh:
-    """1-D mesh over all (or the first ``max_devices``) local devices."""
-    devices = jax.devices()
+    """1-D mesh over all (or the first ``max_devices``) LOCAL devices.
+
+    Local, not global: under jax.distributed the work is
+    target-sharded per host (racon_tpu/parallel/multihost.py) and each
+    rank's batches are host-side numpy arrays, so a mesh spanning
+    another host's non-addressable chips could never be fed."""
+    devices = jax.local_devices()
     if max_devices is not None:
         devices = devices[:max_devices]
     return Mesh(np.array(devices), axis_names=("batch",))

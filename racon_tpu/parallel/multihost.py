@@ -1,0 +1,84 @@
+"""Multi-host scale-out: target-sharded polishing over jax.distributed.
+
+The reference scales past one machine by running independent racon
+processes on slices of the target set (the wrapper's --split flow,
+scripts/racon_wrapper.py); its GPU build adds nothing cross-host — the
+CUDA polisher's per-device batch queues never communicate
+(src/cuda/cudapolisher.cpp:231-243).  The TPU-native analog keeps that
+shape: polishing is data-parallel over TARGETS, so each host process
+owns a deterministic contiguous slice of the target sequences, runs
+the full hybrid polish on its local chips, and emits its slice; rank 0
+(or the caller) concatenates in rank order.  ``jax.distributed``
+provides process bootstrap + the global device view; there are still
+NO collectives in the hot path — ICI/DCN carry nothing but the
+coordinator handshake, exactly like the reference's NCCL-free design.
+
+Usage (one process per host, same arguments everywhere)::
+
+    RACON_TPU_COORD=host0:9876 RACON_TPU_NPROC=4 RACON_TPU_RANK=$i \
+        racon-tpu -c 1 reads.fq.gz ovl.paf.gz draft.fa.gz > part$i.fa
+
+Every process parses the shared inputs (the reference wrapper's
+subprocesses do the same), polishes only its target slice, and writes
+that slice; ``cat part*.fa`` in rank order equals the single-process
+output byte-for-byte (asserted by tests/test_multihost.py on a
+2-process CPU dryrun).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+
+def env_config() -> Optional[Tuple[str, int, int]]:
+    """(coordinator, num_processes, rank) when multi-host env is set,
+    else None (single-host mode)."""
+    coord = os.environ.get("RACON_TPU_COORD")
+    if not coord:
+        return None
+    nproc = int(os.environ.get("RACON_TPU_NPROC", "1"))
+    rank = int(os.environ.get("RACON_TPU_RANK", "0"))
+    if nproc <= 1:
+        return None
+    if not 0 <= rank < nproc:
+        raise ValueError(f"RACON_TPU_RANK {rank} out of range for "
+                         f"RACON_TPU_NPROC {nproc}")
+    return coord, nproc, rank
+
+
+_initialized = False
+
+
+def maybe_initialize() -> Tuple[int, int]:
+    """Bootstrap jax.distributed when configured; returns
+    (num_processes, rank) — (1, 0) in single-host mode.  Idempotent.
+    Must run before the first JAX backend touch (the polisher factory
+    calls it before building the device mesh)."""
+    global _initialized
+    cfg = env_config()
+    if cfg is None:
+        return 1, 0
+    coord, nproc, rank = cfg
+    if not _initialized:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=nproc,
+            process_id=rank,
+            # each host drives only its local chips: the work is
+            # target-sharded, so no global array ever spans hosts
+            local_device_ids=None)
+        _initialized = True
+    return nproc, rank
+
+
+def target_slice(n_targets: int, nproc: int, rank: int) -> slice:
+    """Deterministic contiguous slice of the target index space for
+    one rank: sizes differ by at most one, earlier ranks take the
+    remainder (the wrapper --split analog, but by count rather than
+    bytes; deterministic in the input alone so the concatenated
+    output is reproducible)."""
+    base, rem = divmod(n_targets, nproc)
+    begin = rank * base + min(rank, rem)
+    return slice(begin, begin + base + (1 if rank < rem else 0))

@@ -401,15 +401,19 @@ class TPUPolisher(Polisher):
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::polish] skipped "
                 f"{engine.n_skipped_layers} over-long layer(s)")
-        # drop the first device dispatch and store only when several
-        # remain: the first pays one-time trace/compile/deserialize
-        # costs, and single-dispatch runs (the 47 kb sample) are so
-        # small that fixed dispatch latency swamps the signal --
-        # storing their rates mis-schedules every later run.
-        # Megabase-class runs have many megabatches and calibrate
-        # cleanly.
-        dev_w = sum(w for w, _ in meas["dev"][1:])
-        dev_u = sum(u for _, u in meas["dev"][1:])
+        # drop the first device dispatch when later ones exist: the
+        # first pays one-time trace/compile/deserialize costs.
+        # Single-megabatch runs (the 47 kb sample) keep their one
+        # sample -- dispatch latency biases it slow, but the two-pass
+        # refinement corrects most of that, and a biased-then-refined
+        # rate schedules far better than the frozen default a
+        # small-job-only machine would otherwise keep forever
+        # (measured r5: the sample's POA split never left 32/96
+        # because the drop left zero recorded megabatches).
+        recorded = meas["dev"][1:] if len(meas["dev"]) > 1 \
+            else meas["dev"]
+        dev_w = sum(w for w, _ in recorded)
+        dev_u = sum(u for _, u in recorded)
         _, _, _src = calibrate.get_rates("poa", n_dev, 0.30, 2.0)
         if dev_u > 0 and meas["cpu_u"] > 0 and _src != "env":
             # env-pinned runs (CI, tests) never mutate the machine's

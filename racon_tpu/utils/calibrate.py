@@ -13,14 +13,20 @@ rate is deterministic-but-wrong.  This module makes them measured:
   231-242), done for throughput rates;
 * later runs load the persisted rates, so the chosen split is a pure
   function of the input again and output bytes are reproducible
-  across runs on a machine once calibrated (write-once: set
-  RACON_TPU_RECALIBRATE=1 to refresh after a hardware change);
+  across runs on a machine once calibrated (two-pass-then-frozen:
+  set RACON_TPU_RECALIBRATE=1 to refresh after a hardware change);
 * ``RACON_TPU_RATE_<STAGE>_{DEV,CPU}`` env overrides pin the rates
   exactly — CI's golden configs use these so committed goldens stay
   valid on any hardware;
-* within one process the first lookup is cached, so repeated polishes
-  in-process (the bench's determinism check) always agree even on the
-  very first, yet-uncalibrated run.
+* every polisher instance re-reads the persisted rates, so a process
+  that runs several polishes (bench, a long-running service) adopts
+  its own calibration as soon as it lands — measured r5: the process
+  -level cache this replaced meant a fresh machine's ENTIRE first
+  bench ran on default rates, pinning the mega device share at 39%
+  when the machine's own measurements put the optimum near 80%.
+  Splits still converge because stores freeze at generation 2;
+  in-run determinism checks must therefore compare runs made AFTER
+  the freeze (bench.py runs one settling pass first).
 """
 
 from __future__ import annotations
@@ -30,7 +36,6 @@ import os
 import threading
 
 _lock = threading.Lock()
-_proc_cache: dict = {}
 
 
 def _calib_path():
@@ -68,33 +73,28 @@ def _machine_key(n_dev: int) -> str:
 def get_rates(stage: str, n_dev: int, default_dev: float,
               default_cpu: float) -> tuple:
     """(dev_rate, cpu_rate, source) for a hybrid stage.  Precedence:
-    env pin > process cache > persisted calibration > defaults.  The
-    result is cached per process so every polish in one process uses
-    identical rates (split determinism within a run)."""
-    key = (stage, n_dev)
-    with _lock:
-        if key in _proc_cache:
-            return _proc_cache[key]
-        env_dev = os.environ.get(f"RACON_TPU_RATE_{stage.upper()}_DEV")
-        env_cpu = os.environ.get(f"RACON_TPU_RATE_{stage.upper()}_CPU")
-        if env_dev and env_cpu:
-            out = (float(env_dev), float(env_cpu), "env")
-        else:
-            out = (default_dev, default_cpu, "default")
-            if not os.environ.get("RACON_TPU_RECALIBRATE") \
-                    and _calib_path():
-                try:
-                    with open(_calib_path()) as f:
-                        data = json.load(f)
-                    ent = data.get(_machine_key(n_dev), {}).get(stage)
-                    if ent:
-                        out = (float(ent.get("dev", default_dev)),
-                               float(ent.get("cpu", default_cpu)),
-                               "calibrated")
-                except Exception:
-                    pass
-        _proc_cache[key] = out
-        return out
+    env pin > persisted calibration > defaults.  Reads the persisted
+    file on every call (it is tiny), so a multi-polish process adopts its own
+    measurements as they land; within one polish each stage reads its
+    rates once, so a single run's split stays internally coherent."""
+    env_dev = os.environ.get(f"RACON_TPU_RATE_{stage.upper()}_DEV")
+    env_cpu = os.environ.get(f"RACON_TPU_RATE_{stage.upper()}_CPU")
+    if env_dev and env_cpu:
+        return (float(env_dev), float(env_cpu), "env")
+    out = (default_dev, default_cpu, "default")
+    if not os.environ.get("RACON_TPU_RECALIBRATE") and _calib_path():
+        with _lock:
+            try:
+                with open(_calib_path()) as f:
+                    data = json.load(f)
+                ent = data.get(_machine_key(n_dev), {}).get(stage)
+                if ent:
+                    out = (float(ent.get("dev", default_dev)),
+                           float(ent.get("cpu", default_cpu)),
+                           "calibrated")
+            except Exception:
+                pass
+    return out
 
 
 def store_rates(stage: str, n_dev: int, dev_rate: float,

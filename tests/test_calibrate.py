@@ -1,7 +1,10 @@
 """Self-calibrating hybrid-split rates (racon_tpu/utils/calibrate.py).
 
-The split model's rates resolve env pin > process cache > persisted
-calibration > defaults; persistence is write-once per machine key.
+The split model's rates resolve env pin > persisted calibration >
+defaults; persistence is two-pass-then-frozen per machine key, and
+every lookup re-reads the file so a multi-polish process adopts its
+own calibration as it lands (r5: the process cache this replaced made
+a fresh machine's entire first bench run on default rates).
 """
 
 import json
@@ -19,9 +22,7 @@ def calib_dir(tmp_path, monkeypatch):
     for v in ("RACON_TPU_RATE_POA_DEV", "RACON_TPU_RATE_POA_CPU",
               "RACON_TPU_RATE_ALIGN_DEV", "RACON_TPU_RATE_ALIGN_CPU"):
         monkeypatch.delenv(v, raising=False)
-    calibrate._proc_cache.clear()
     yield tmp_path
-    calibrate._proc_cache.clear()
 
 
 def test_defaults_when_uncalibrated(calib_dir):
@@ -39,7 +40,6 @@ def test_env_pin_wins(calib_dir, monkeypatch):
 
 def test_store_then_load_roundtrip(calib_dir):
     calibrate.store_rates("poa", 1, 0.123, 1.77)
-    calibrate._proc_cache.clear()
     dev, cpu, src = calibrate.get_rates("poa", 1, 0.30, 2.0)
     assert src == "calibrated"
     assert dev == pytest.approx(0.123, abs=1e-3)
@@ -53,7 +53,6 @@ def test_two_pass_then_frozen(calib_dir):
     calibrate.store_rates("align", 1, 1000.0, 4.0)   # gen 1
     calibrate.store_rates("align", 1, 1500.0, 5.0)   # gen 2 refines
     calibrate.store_rates("align", 1, 5555.0, 9.0)   # frozen: ignored
-    calibrate._proc_cache.clear()
     dev, cpu, src = calibrate.get_rates("align", 1, 1100.0, 4.0)
     assert dev == pytest.approx(1500.0)
 
@@ -63,18 +62,25 @@ def test_recalibrate_env_overwrites(calib_dir, monkeypatch):
     monkeypatch.setenv("RACON_TPU_RECALIBRATE", "1")
     calibrate.store_rates("align", 1, 2000.0, 5.0)
     monkeypatch.delenv("RACON_TPU_RECALIBRATE")
-    calibrate._proc_cache.clear()
     dev, cpu, src = calibrate.get_rates("align", 1, 1100.0, 4.0)
     assert dev == pytest.approx(2000.0)
 
 
-def test_process_cache_freezes_first_lookup(calib_dir):
-    """Repeated polishes in one process must use identical rates even
-    if a calibration lands mid-process (split determinism)."""
+def test_in_process_adoption(calib_dir):
+    """A calibration landing mid-process IS adopted by the next
+    lookup: the next polisher instance schedules with the machine's
+    own measured rates (the settle pass in bench.py relies on this;
+    post-freeze lookups stay constant for determinism)."""
     dev1, cpu1, src1 = calibrate.get_rates("poa", 1, 0.30, 2.0)
+    assert src1 == "default"
     calibrate.store_rates("poa", 1, 0.01, 0.02)
     dev2, cpu2, src2 = calibrate.get_rates("poa", 1, 0.30, 2.0)
-    assert (dev1, cpu1, src1) == (dev2, cpu2, src2)
+    assert (dev2, cpu2, src2) == (0.01, 0.02, "calibrated")
+    # generation 2 refines once more; generation 3+ is ignored
+    calibrate.store_rates("poa", 1, 0.5, 0.5)   # gen 2: adopted
+    calibrate.store_rates("poa", 1, 0.7, 0.7)   # gen 3: frozen out
+    dev3, cpu3, _ = calibrate.get_rates("poa", 1, 0.30, 2.0)
+    assert (dev3, cpu3) == (0.5, 0.5)
 
 
 def test_bad_rates_not_stored(calib_dir):
@@ -86,6 +92,5 @@ def test_bad_rates_not_stored(calib_dir):
 
 def test_dev_only_store_keeps_cpu_default(calib_dir):
     calibrate.store_rates("align", 1, 800.0)
-    calibrate._proc_cache.clear()
     dev, cpu, src = calibrate.get_rates("align", 1, 1100.0, 4.0)
     assert (dev, cpu, src) == (pytest.approx(800.0), 4.0, "calibrated")

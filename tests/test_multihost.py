@@ -1,0 +1,132 @@
+"""Multi-host scale-out (racon_tpu/parallel/multihost.py).
+
+Validates the jax.distributed target-sharding path with a REAL
+2-process CPU dryrun: two ranks bootstrap through a local coordinator,
+each polishes its deterministic target slice, and the rank-ordered
+concatenation must equal the single-process output byte-for-byte --
+the cross-host analog of the wrapper's split==unsplit identity
+(tests/test_tools.py).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from racon_tpu.parallel import multihost
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_target_slice_partition():
+    for n, k in ((1, 2), (5, 2), (7, 3), (12, 4), (3, 8)):
+        slices = [multihost.target_slice(n, k, r) for r in range(k)]
+        seen = []
+        for sl in slices:
+            seen.extend(range(n)[sl])
+        assert seen == list(range(n))
+        sizes = [sl.stop - sl.start for sl in slices]
+        assert max(sizes) - min(sizes) <= 1
+
+
+def test_env_config_validation(monkeypatch):
+    monkeypatch.delenv("RACON_TPU_COORD", raising=False)
+    assert multihost.env_config() is None
+    monkeypatch.setenv("RACON_TPU_COORD", "localhost:9999")
+    monkeypatch.setenv("RACON_TPU_NPROC", "2")
+    monkeypatch.setenv("RACON_TPU_RANK", "1")
+    assert multihost.env_config() == ("localhost:9999", 2, 1)
+    monkeypatch.setenv("RACON_TPU_RANK", "2")
+    with pytest.raises(ValueError):
+        multihost.env_config()
+
+
+def _combined_dataset(tmp_path):
+    """Two small simulated contigs merged into one reads/paf/draft
+    trio (the simulator is single-contig; names are prefixed so the
+    merged files stay collision-free)."""
+    from racon_tpu.tools import simulate
+
+    reads_out = tmp_path / "reads.fastq"
+    paf_out = tmp_path / "ovl.paf"
+    draft_out = tmp_path / "draft.fasta"
+    with open(reads_out, "wb") as rf, open(paf_out, "wb") as pf, \
+            open(draft_out, "wb") as df:
+        for part, seed in ((b"a", 3), (b"b", 4)):
+            d = tmp_path / f"part_{part.decode()}"
+            reads, paf, draft = simulate.simulate(
+                str(d), genome_len=30_000, coverage=10,
+                read_len=3_000, seed=seed)
+            pre = part + b"_"
+            with open(reads, "rb") as fh:
+                for i, line in enumerate(fh):
+                    if i % 4 == 0:
+                        line = b"@" + pre + line[1:]
+                    rf.write(line)
+            with open(draft, "rb") as fh:
+                for line in fh:
+                    if line.startswith(b">"):
+                        line = b">" + pre + line[1:]
+                    df.write(line)
+            with open(paf, "rb") as fh:
+                for line in fh:
+                    cols = line.split(b"\t")
+                    cols[0] = pre + cols[0]
+                    cols[5] = pre + cols[5]
+                    pf.write(b"\t".join(cols))
+    return str(reads_out), str(paf_out), str(draft_out)
+
+
+def _cli_env(extra=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("RACON_TPU_COORD", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _run_cli(args, env, timeout=600):
+    return subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "4",
+         "-m", "5", "-x", "-4", "-g", "-8"] + list(args),
+        capture_output=True, env=env, cwd=REPO, timeout=timeout)
+
+
+@pytest.mark.slow
+def test_two_process_dryrun_matches_single(tmp_path):
+    reads, paf, draft = _combined_dataset(tmp_path)
+    inputs = [reads, paf, draft]
+
+    single = _run_cli(inputs, _cli_env())
+    assert single.returncode == 0, single.stderr.decode()[-2000:]
+    assert single.stdout.count(b">") == 2
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(2):
+        env = _cli_env({
+            "RACON_TPU_COORD": f"localhost:{port}",
+            "RACON_TPU_NPROC": "2",
+            "RACON_TPU_RANK": str(rank),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "racon_tpu.cli", "-t", "4",
+             "-m", "5", "-x", "-4", "-g", "-8"] + inputs,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=env, cwd=REPO))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, err.decode()[-2000:]
+        outs.append(out)
+
+    # each rank emits exactly its own slice; rank-ordered cat equals
+    # the single-process bytes
+    assert outs[0].count(b">") == 1
+    assert outs[1].count(b">") == 1
+    assert outs[0] + outs[1] == single.stdout
