@@ -434,7 +434,7 @@ def mega_ont_bench():
         "mega_ont", "mega_ont (2.3Mb, 30x ONT model)",
         dict(genome_len=2_300_000, coverage=30, read_len=10_000,
              seed=13, ont=True),
-        260, 500, "RACON_TPU_BENCH_MEGA_ONT")
+        560, 500, "RACON_TPU_BENCH_MEGA_ONT")
 
 
 if __name__ == "__main__":
