@@ -1,0 +1,66 @@
+"""Shape policy helpers of the flagship kernels (pure functions).
+
+These pins make cold-start coverage auditable: prewarm/prebuild must
+predict the exact batch a dispatch will run (racon_tpu/tpu/
+poa_pallas.py padded_batch), and the windows-per-program selection
+decides which configurations the flagship kernel serves at all.
+"""
+
+import pytest
+
+from racon_tpu.tpu import align_pallas, poa_pallas
+
+
+@pytest.fixture(autouse=True)
+def _no_swin_override(monkeypatch):
+    # a developer's exported RACON_TPU_POA_SWIN must not fail the
+    # stock-policy pins; the override test sets it explicitly
+    monkeypatch.delenv("RACON_TPU_POA_SWIN", raising=False)
+
+
+def test_windows_per_program_stock_configs():
+    # stock w=500 caps fit three windows per program; w=1000 caps one
+    wb500 = poa_pallas.band_width(1024)
+    assert wb500 == 256
+    assert poa_pallas.pick_windows_per_program(
+        2048, 1024, 32, 16, 16, 8, wb500) == 3
+    wb1000 = poa_pallas.band_width(2048)
+    assert wb1000 == 512
+    assert poa_pallas.pick_windows_per_program(
+        4096, 2048, 32, 16, 16, 8, wb1000) == 1
+    # the banded w=1000 band (256 cols) also runs at S=1
+    wb1000b = poa_pallas.band_width(2048, banded=True)
+    assert wb1000b == 256
+    assert poa_pallas.pick_windows_per_program(
+        4096, 2048, 32, 16, 16, 8, wb1000b) == 1
+
+
+def test_windows_per_program_env_override(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_POA_SWIN", "2")
+    assert poa_pallas.pick_windows_per_program(
+        2048, 1024, 32, 16, 16, 8, 256) == 2
+    # a forced factor that does not fit reports 0 (caller falls back)
+    monkeypatch.setenv("RACON_TPU_POA_SWIN", "8")
+    assert poa_pallas.pick_windows_per_program(
+        2048, 1024, 32, 16, 16, 8, 256) == 0
+
+
+def test_padded_batch_matches_dispatch_multiples():
+    # w=500 class: s_win=3, one device -> multiples of 3
+    for b, want in ((64, 66), (32, 33), (256, 258), (66, 66)):
+        assert poa_pallas.padded_batch(b, 1, 2048, 1024, 32) == want
+    # w=1000 class: s_win=1 -> identity
+    assert poa_pallas.padded_batch(
+        32, 1, 4096, 2048, 32, wb=512) == 32
+    # mesh multiple folds in
+    assert poa_pallas.padded_batch(64, 8, 2048, 1024, 32) == 72
+
+
+def test_align_pad_pairs_floor():
+    # floor 32 bounds the compiled-variant set (manifest coverage)
+    assert align_pallas.pad_pairs(1) == 32
+    assert align_pallas.pad_pairs(8) == 32
+    assert align_pallas.pad_pairs(33) == 64
+    assert align_pallas.pad_pairs(128) == 128
+    # mesh multiple preserved
+    assert align_pallas.pad_pairs(40, 8) % (8 * 8) == 0
