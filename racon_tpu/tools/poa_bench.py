@@ -93,22 +93,27 @@ def main(argv=None):
         return 1
     s_win = poa_pallas.pick_windows_per_program(
         args.v, args.lp, d1, 16, 16, 8, wb)
+    krank = poa_pallas.pick_rank_unroll(
+        args.v, args.lp, d1, 16, 16, 8, wb, s_win)
 
     def run_batch():
         if args.prof:
             # direct _poa_full call (bypasses the AOT shelf: prof
-            # variants must not pollute it)
+            # variants must not pollute it); pad to the group multiple
+            # like production dispatch does
             import numpy as np
             sq, wt, me, nl, bb = data
             b0 = sq.shape[0]
-            assert b0 % s_win == 0
+            if b0 % s_win:
+                sq, wt, me, nl, bb = poa_pallas._pad_pairs(
+                    sq, wt, me, nl, bb, s_win)
             cons, mout = poa_pallas._poa_full(
                 jnp.asarray(sq), jnp.asarray(wt), jnp.asarray(me),
                 jnp.asarray(nl), jnp.asarray(bb),
                 args.v, args.lp, d1, 16, 16, 8, 128, wb,
-                5, -4, -8, 1, 1, s_win, False, args.prof)
-            return (np.asarray(cons).reshape(b0, -1),
-                    np.asarray(mout)[:, :, 0])
+                5, -4, -8, 1, 1, s_win, krank, False, args.prof)
+            return (np.asarray(cons).reshape(-1, args.v)[:b0],
+                    np.asarray(mout)[:b0, :, 0])
         return poa_pallas.poa_full_batch(
             *data, v=args.v, lp=args.lp, d1=d1, wb=wb)
 
@@ -119,7 +124,7 @@ def main(argv=None):
     cells = ranks * wb
     print(f"[poa_bench] b={args.b} depth={args.depth} wlen={args.wlen}"
           f" v={args.v} lp={args.lp} wb={wb} s_win={s_win} "
-          f"rank_steps={ranks} fails={fails}")
+          f"krank={krank} rank_steps={ranks} fails={fails}")
     best = float("inf")
     for r in range(args.reps):
         t0 = time.monotonic()

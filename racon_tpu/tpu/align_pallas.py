@@ -498,6 +498,9 @@ def align_dispatch(queries, targets, lq: int, lt: int, wb: int,
     pipeline's analog, racon_tpu/tpu/polisher.py)."""
     from racon_tpu.tpu.aligner import encode_batch, _QPAD, _TPAD
 
+    import threading
+    import time
+
     n_real = len(queries)
     n_dev = len(mesh.devices) if mesh is not None else 1
     # pad the pair count to a power of two so grid sizes (and thus
@@ -512,6 +515,7 @@ def align_dispatch(queries, targets, lq: int, lt: int, wb: int,
     from racon_tpu.parallel.mesh_utils import interpret_mode
 
     interp = interpret_mode()
+    t_disp = time.monotonic()
     if n_dev > 1:
         tape, meta = _align_sharded(q, t, ql, tl, mesh=mesh, lq=lq,
                                     lt=lt, wb=wb, interpret=interp)
@@ -527,16 +531,36 @@ def align_dispatch(queries, targets, lq: int, lt: int, wb: int,
     tape.copy_to_host_async()
     meta.copy_to_host_async()
 
+    # host-independent per-dispatch device time: the watcher blocks
+    # on the outputs from dispatch-enqueue on, so host work between
+    # dispatch and collect (decoding the previous chunk under the
+    # two-deep pipeline) never inflates the span -- the bench's
+    # align_device_s (VERDICT r5 #8)
+    span = {}
+
+    def _watch():
+        try:
+            jax.block_until_ready((tape, meta))
+            span["s"] = time.monotonic() - t_disp
+        except Exception:
+            pass  # dispatch errors surface at collect()
+
+    watcher = threading.Thread(target=_watch, daemon=True,
+                               name="racon-align-devtime")
+    watcher.start()
+
     def collect():
         tp = np.asarray(tape)[:n_real].reshape(n_real, -1) \
             .astype(np.uint32)
         mt = np.asarray(meta)[:n_real, :, 0]
+        watcher.join()
         n = tp.shape[1] * 16
         moves = np.zeros((tp.shape[0], n), np.uint8)
         for sh in range(16):
             moves[:, sh::16] = (tp >> (2 * sh)) & 3
         return moves, mt[:, 1], mt[:, 0]
 
+    collect.device_s = lambda: span.get("s", 0.0)
     return collect
 
 

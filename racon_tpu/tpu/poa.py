@@ -416,6 +416,10 @@ class TPUPoaBatchEngine:
         # device step, extract is final consensus generation
         self.phase_walls = {"export": 0.0, "dispatch": 0.0,
                             "apply": 0.0, "extract": 0.0}
+        # host-independent cumulative device time (watcher-thread
+        # spans from poa_pallas.poa_full_dispatch; 0.0 on the
+        # lockstep path, which has no async dispatch to watch)
+        self.device_s = 0.0
         self.n_rounds = 0
 
     def consensus_batch(self, windows, trim: bool, pool=None) \
@@ -604,8 +608,12 @@ class TPUPoaBatchEngine:
             # NOTE under the two-deep pipeline: "dispatch" counts only
             # the UN-overlapped blocking residual (device time hidden
             # behind the next batch's packing shows up in no bucket),
-            # so phase walls no longer sum to the stage wall
+            # so phase walls no longer sum to the stage wall; the
+            # watcher-thread span below is the host-independent
+            # per-dispatch device time
             self.phase_walls["dispatch"] += blocked
+            self.device_s += getattr(handle, "device_s",
+                                     lambda: 0.0)()
             if os.environ.get("RACON_TPU_POA_TRACE"):
                 import sys
                 live = nlay[:n][nlay[:n] > 0]

@@ -3,7 +3,7 @@
 One grid program runs the ENTIRE partial-order-alignment consensus --
 graph construction, per-layer banded DP, traceback, graph merge,
 heaviest-bundle consensus, TGS trim -- for a GROUP of S windows
-(``pick_windows_per_program``: 3 at the stock w=500 caps, 1 at
+(``pick_windows_per_program``: 5 at the stock w=500 caps, 2 at
 w=1000), with all S POA graphs resident in VMEM/SMEM.  This is the
 cudapoa architecture (reference: one CUDA thread block per POA group,
 src/cuda/cudabatch.cpp:52-265) mapped to the TensorCore: host
@@ -21,9 +21,26 @@ such independent work: interleaving S windows' rank bodies in one
 straight-line region lets the scheduler fill one chain's stalls with
 the others' ops, targeting ~Sx per-window throughput at unchanged op
 count.  S is capped by SMEM: each window's per-node scalars must
-stay scalar-addressable (26 ints/node after the r5 diet: the
-consensus-phase arrays alias layer-phase arrays that are dead by
-consensus time, and pred-weight slots 8+ spill to a VMEM row).
+stay scalar-addressable.  The r6 diet packs them to 13 ints/node
+(down from the r5 diet's 26): the ten per-node scalar arrays hold
+values < 2^16, so they live as five half-width PAIRS packed two
+fields per int32 (base|nseq, anch|minsucc, nxt|glast, pcnt|scnt,
+gcnt|bandq), the whole pred-weight mirror spills to a VMEM row per
+node (weights exceed 16 bits and their accumulate is a masked
+vector add, not a chain-latency scalar read), and the consensus
+score array -- the one field that genuinely needs 32 bits -- aliases
+the path tape, which is dead until the consensus backtrack.  That
+takes the stock w=500 shape from S=3 to S=5 and w=1000 from 1 to 2.
+
+On top of S, the joint DP walk steps KRANK ranks of every window per
+while-loop iteration (multi-rank stepping): topo runs of single-
+predecessor backbone nodes -- the overwhelmingly common case -- make
+almost every unrolled step productive, so the loop's per-iteration
+overhead (condition fold, carry shuffle, region boundary) is paid
+once per KRANK ranks and the straight-line region grows to
+S x KRANK interleavable rank bodies.  Inert tail steps (a window
+whose walk already ended) are free: the rank body is fully gated on
+node >= 0.
 
 Why not the lockstep host-graph design (racon_tpu/tpu/poa.py)?  On
 the tunneled-TPU deployment target, host<->device transfers cost
@@ -75,7 +92,6 @@ from jax.experimental.pallas import tpu as pltpu
 
 _BIG = 1 << 28
 _N_SHIFT = 4          # pred band may lag <= 3 quanta of 128
-_INF32 = np.int32(2147483647 // 2)
 
 # fail codes (observability parity with the lockstep export codes)
 FAIL_VCAP = 1
@@ -139,29 +155,55 @@ def prewarm(b: int, d1: int, *, v: int, lp: int, wb: int,
 
 
 def _fits_s(v: int, lp: int, d1: int, p: int, s: int, a: int,
-            wb: int, s_win: int) -> bool:
+            wb: int, s_win: int, krank: int = 1) -> bool:
     """Conservative per-program VMEM/SMEM estimate for the kernel at
-    ``s_win`` windows per program."""
-    pw = max(p - 8, 1)
+    ``s_win`` windows per program and ``krank`` ranks per joint DP
+    iteration."""
     vmem = (s_win * v * wb * 4                # packed score|code rows
             + s_win * v * (p + s) * 4         # adjacency ids (VMEM)
             + s_win * v * a * 4               # aligned groups
-            + s_win * v * pw * 4              # pred-weight spill rows
+            + s_win * v * p * 4               # pred-weight rows (all
+                                              # p slots; r6 diet moved
+                                              # the 8-slot SMEM mirror
+                                              # here)
             + 2 * 8 * (lp + 256) * 4          # staged chw + chars rows
             + 2 * 2 * s_win * d1 * lp * 4)    # seq/wts blocks x2 buf
-    # SMEM per window after the r5 diet: 10 v-sized scalar arrays
-    # (base/anchor/nseq/next/glast/bandq/pcnt/scnt/gcnt/minsucc; the
-    # consensus score/cpred/order alias anchor/bandq/glast), the
-    # 8-slot pred id mirror and 8-slot pred weights, the packed path
-    # and regs; shared: the chw mirror and the consensus staging
-    smem = (s_win * (v * (10 + 8 + 8) + (v + lp) + _NREG)
+    # the kernel is granted a 64M scoped-vmem limit (v5e has 128M);
+    # the compiler's stack temporaries for the interleaved straight-
+    # line window bodies come out of the same scope (measured r5:
+    # ~3M per window body at krank=1, d1=32; each extra unrolled rank
+    # body adds ~0.75M since the per-window carried state is shared
+    # across the unroll) -- budget declared + temps against 44M,
+    # leaving 20M slack for pipeline buffers and measurement error
+    temps = s_win * ((3 << 20) + ((3 << 20) >> 2) * (krank - 1))
+    # SMEM per window after the r6 diet: FIVE packed v-sized arrays
+    # (base|nseq, anch|minsucc, nxt|glast, pcnt|scnt, gcnt|bandq --
+    # every field < 2^16; consensus cpred/order reuse the bandq/glast
+    # halves, consensus score aliases the 32-bit path tape), the
+    # 8-slot pred id mirror, the packed path and regs; shared: the
+    # chw mirror and the consensus staging
+    smem = (s_win * (v * (5 + 8) + (v + lp) + _NREG)
             + 8 * (lp + 256) + s_win * (v // 128) * 128
             + s_win * d1 * 8) * 4
-    # the kernel is granted a 64M scoped-vmem limit (v5e has 128M);
-    # leave ~40M headroom for the compiler's stack temporaries, which
-    # scale with s_win (measured r5: ~3M per interleaved window body
-    # at d1=32)
-    return vmem <= (24 << 20) and smem <= (768 << 10)
+    return vmem + temps <= (44 << 20) and smem <= (768 << 10)
+
+
+def _forced_env_factor(name: str) -> int:
+    """Parse a forced kernel-shape factor env var; None when unset.
+    Malformed values fail LOUDLY naming the variable (a typo silently
+    routing every window to the lockstep engine cost a round of
+    confusion, ADVICE r5)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be a positive integer, got {raw!r}")
+    if not 1 <= val <= 8:
+        raise ValueError(f"{name} must be in [1, 8], got {val}")
+    return val
 
 
 def pick_windows_per_program(v: int, lp: int, d1: int, p: int = 16,
@@ -171,15 +213,51 @@ def pick_windows_per_program(v: int, lp: int, d1: int, p: int = 16,
     shape does not fit at all and the caller must use the lockstep
     engine).  More windows per program = more independent serial DP
     chains for the VLIW scheduler to interleave (see module
-    docstring); the stock w=500 config fits 3, the w=1000 config 1."""
-    force = os.environ.get("RACON_TPU_POA_SWIN")
-    if force:
-        sf = int(force)
-        return sf if _fits_s(v, lp, d1, p, s, a, wb, sf) else 0
-    for s_win in (3, 2, 1):
+    docstring); the stock w=500 config fits 5 after the r6 SMEM diet,
+    the w=1000 config 2."""
+    force = _forced_env_factor("RACON_TPU_POA_SWIN")
+    if force is not None:
+        if _fits_s(v, lp, d1, p, s, a, wb, force):
+            return force
+        import warnings
+        warnings.warn(
+            f"RACON_TPU_POA_SWIN={force} exceeds the kernel budget "
+            f"for shape v={v} lp={lp} d1={d1} wb={wb}; the flagship "
+            "kernel is unavailable and windows fall back to the "
+            "lockstep engine", RuntimeWarning, stacklevel=2)
+        return 0
+    for s_win in (6, 5, 4, 3, 2, 1):
         if _fits_s(v, lp, d1, p, s, a, wb, s_win):
             return s_win
     return 0
+
+
+def pick_rank_unroll(v: int, lp: int, d1: int, p: int = 16,
+                     s: int = 16, a: int = 8, wb: int = 256,
+                     s_win: int = 0) -> int:
+    """Ranks of every window processed per joint DP iteration
+    (multi-rank stepping, see module docstring).  Largest of 4/2/1
+    whose compiler-temp footprint still fits next to ``s_win``
+    interleaved windows; RACON_TPU_POA_KRANK forces it (budget-
+    rejected forces warn and fall back to the policy pick)."""
+    if not s_win:
+        s_win = pick_windows_per_program(v, lp, d1, p, s, a, wb)
+    if s_win <= 0:
+        return 1
+    force = _forced_env_factor("RACON_TPU_POA_KRANK")
+    if force is not None:
+        if _fits_s(v, lp, d1, p, s, a, wb, s_win, force):
+            return force
+        import warnings
+        warnings.warn(
+            f"RACON_TPU_POA_KRANK={force} exceeds the kernel budget "
+            f"for shape v={v} lp={lp} d1={d1} wb={wb} at "
+            f"{s_win} windows/program; using the policy pick instead",
+            RuntimeWarning, stacklevel=2)
+    for krank in (4, 2, 1):
+        if _fits_s(v, lp, d1, p, s, a, wb, s_win, krank):
+            return krank
+    return 1
 
 
 def fits(v: int, lp: int, d1: int, p: int, s: int, a: int,
@@ -203,18 +281,28 @@ def padded_batch(b: int, n_dev: int, v: int, lp: int, d1: int,
     return b + (-b) % mult
 
 
+# packed SMEM pairs (r6 diet): each (v,) int32 array holds TWO
+# 16-bit fields, lo | hi << 16 (every field's range is < 2^16):
+#   bnsq: base | nseq        anms: anch | minsucc (0xFFFF = inf)
+#   nxgl: nxt+1 | glast      pcsc: pcnt | scnt
+#   gcbq: gcnt | bandq       (bandq packs (d << 8) | band quantum;
+#                             0 = no epoch, valid only when the
+#                             stored d matches the current layer)
+# consensus reuse: cpred lives in the bandq half (biased +1), order
+# in the glast half, and the 32-bit score array aliases the path
+# tape (dead until the consensus backtrack).
 _SCRATCH_PER_WIN = ("preds", "succs", "ring", "accs",
-                    "arga", "aligsm", "predwv", "base", "anch",
-                    "nseq", "nxt", "glast", "bandq", "pcnt", "scnt",
-                    "predsm", "predw", "path", "gcnt", "regs",
-                    "minsucc")
+                    "arga", "aligsm", "predwv", "bnsq", "anms",
+                    "nxgl", "pcsc", "gcbq", "predsm", "path", "regs")
+
+_INF16 = np.int32(0xFFFF)     # minsucc "no successor" sentinel
 
 
 def _kernel(nlay_ref, bblen_ref,
             seqs_ref, wts_ref, meta_ref,
             cons_ref, mout_ref, *scr,
             v: int, lp: int, d1: int, p: int, s_: int, a_: int,
-            k: int, wb: int, s_win: int,
+            k: int, wb: int, s_win: int, krank: int,
             match: int, mismatch: int, gap: int,
             wtype: int, trim: int, prof: int = 0):
     S = s_win
@@ -238,29 +326,31 @@ def _kernel(nlay_ref, bblen_ref,
     arga_u = grp["arga"]
     aligsm_u = grp["aligsm"]
     predwv_u = grp["predwv"]
-    base_u = grp["base"]
-    anch_u = grp["anch"]
-    nseq_u = grp["nseq"]
-    nxt_u = grp["nxt"]
-    glast_u = grp["glast"]
-    bandq_u = grp["bandq"]
-    pcnt_u = grp["pcnt"]
-    scnt_u = grp["scnt"]
+    bnsq_u = grp["bnsq"]
+    anms_u = grp["anms"]
+    nxgl_u = grp["nxgl"]
+    pcsc_u = grp["pcsc"]
+    gcbq_u = grp["gcbq"]
     predsm_u = grp["predsm"]
-    predw_u = grp["predw"]
     path_u = grp["path"]
-    gcnt_u = grp["gcnt"]
     regs_u = grp["regs"]
-    minsucc_u = grp["minsucc"]
-    # consensus-phase arrays alias per-layer state that is DEAD by the
-    # time consensus runs (part of the r5 SMEM diet: 3 fewer v-sized
-    # SMEM arrays per window):
-    #   score  <- anch  (anchors are only read during merge)
-    #   cpred  <- bandq (band epochs are only read during DP/traceback)
-    #   order  <- glast (group-last is only read during merge)
-    score_u = anch_u
-    cpred_u = bandq_u
-    order_u = glast_u
+    # consensus score is the one per-node field needing 32 bits; it
+    # aliases the path tape, dead until the consensus backtrack (the
+    # backtrack only starts after the forward DP's last score read)
+    score_u = path_u
+
+    M16 = jnp.int32(0xFFFF)
+    NM16 = jnp.int32(-65536)          # ~M16: keep-hi mask
+
+    def lo16(x):
+        """Unsigned lo half of a packed word."""
+        return x & M16
+
+    def hi16(x):
+        """Unsigned hi half of a packed word (mask because the int32
+        arithmetic shift sign-extends when the hi field's top bit is
+        set, e.g. the 0xFFFF minsucc sentinel)."""
+        return (x >> 16) & M16
 
     def stage_chw():
         """Copy the staged packed char*256+weight rows into SMEM: the
@@ -285,10 +375,6 @@ def _kernel(nlay_ref, bblen_ref,
     iota_p = lax.broadcasted_iota(jnp.int32, (1, p), 1)
     iota_s = lax.broadcasted_iota(jnp.int32, (1, s_), 1)
     iota_a = lax.broadcasted_iota(jnp.int32, (1, a_), 1)
-    # pred-weight spill width: slots 0-7 live in SMEM (the hot,
-    # in-degree <= 8 case), slots 8..p-1 in a VMEM row per node
-    pw = max(p - 8, 1)
-    iota_pw = lax.broadcasted_iota(jnp.int32, (1, pw), 1)
     # path pack radix: entry = (node+2)*pkr + (spos+2); spos < lp and
     # node < v, so pkr must clear lp (the wrapper asserts the product
     # fits int32)
@@ -324,8 +410,8 @@ def _kernel(nlay_ref, bblen_ref,
 
     def init_nodes(j, _):
         for u in range(S):
-            bandq_u[u][j] = jnp.int32(-1)
-            gcnt_u[u][j] = jnp.int32(0)
+            # gcnt 0, bandq no-epoch -- one packed store per node
+            gcbq_u[u][j] = jnp.int32(0)
         return 0
 
     lax.fori_loop(0, v, init_nodes, 0)
@@ -363,15 +449,14 @@ def _kernel(nlay_ref, bblen_ref,
 
         @pl.when(act)
         def _():
-            base_u[u][j] = c
-            anch_u[u][j] = j
-            nseq_u[u][j] = jnp.int32(1)
-            nxt_u[u][j] = jnp.where(j + 1 < bbl_u[u], j + 1, -1)
-            glast_u[u][j] = j
-            pcnt_u[u][j] = jnp.where(j > 0, 1, 0)
-            scnt_u[u][j] = jnp.where(j + 1 < bbl_u[u], 1, 0)
-            minsucc_u[u][j] = jnp.where(j + 1 < bbl_u[u], j + 1,
-                                        _INF32)
+            has_nxt = j + 1 < bbl_u[u]
+            bnsq_u[u][j] = c | (1 << 16)              # base, nseq=1
+            anms_u[u][j] = j | (jnp.where(has_nxt, j + 1,
+                                          _INF16) << 16)
+            nxgl_u[u][j] = jnp.where(has_nxt, j + 2, 0) \
+                | (j << 16)                           # nxt+1, glast=j
+            pcsc_u[u][j] = jnp.where(j > 0, 1, 0) \
+                | (jnp.where(has_nxt, 1, 0) << 16)
             predsm_u[u][(j) * 8 + 0] = j - 1
 
             @pl.when(j > 0)
@@ -380,7 +465,9 @@ def _kernel(nlay_ref, bblen_ref,
                 # only the data-dependent weight is per-node
                 # (pred-side only: consensus scores in-edges, so succ
                 # weights would be dead state)
-                predw_u[u][(j) * 8 + 0] = prev_w + w
+                wrow = vload(predwv_u[u], j)
+                predwv_u[u][pl.ds(j, 1), :] = jnp.where(
+                    iota_p == 0, prev_w + w, wrow)
         return jnp.where(act, w, prev_w)
 
     def seed(j, carry):
@@ -397,15 +484,18 @@ def _kernel(nlay_ref, bblen_ref,
     # ---- helpers shared by the merge step (u is a python int) -------
 
     def insert_after(u, pos, node):
-        """Linked-list insert; pos == -1 -> new head."""
+        """Linked-list insert; pos == -1 -> new head.  nxt lives in
+        the lo half of nxgl (biased +1, 0 = end of list)."""
         @pl.when(pos >= 0)
         def _():
-            nxt_u[u][node] = nxt_u[u][pos]
-            nxt_u[u][pos] = node
+            w_pos = nxgl_u[u][pos]
+            nxgl_u[u][node] = (nxgl_u[u][node] & NM16) | (w_pos & M16)
+            nxgl_u[u][pos] = (w_pos & NM16) | (node + 1)
 
         @pl.when(pos < 0)
         def _():
-            nxt_u[u][node] = regs_u[u][1]
+            nxgl_u[u][node] = (nxgl_u[u][node] & NM16) \
+                | (regs_u[u][1] + 1)
             regs_u[u][1] = node
 
     def new_node(u, c, anchor, pos):
@@ -415,19 +505,15 @@ def _kernel(nlay_ref, bblen_ref,
 
         @pl.when(ok)
         def _():
-            base_u[u][nid] = c
-            anch_u[u][nid] = anchor
-            nseq_u[u][nid] = jnp.int32(0)
-            glast_u[u][nid] = nid
-            bandq_u[u][nid] = jnp.int32(-1)
+            bnsq_u[u][nid] = c                   # base; nseq = 0
+            anms_u[u][nid] = anchor | NM16       # minsucc = 0xFFFF
+            nxgl_u[u][nid] = nid << 16           # no nxt; glast = nid
+            gcbq_u[u][nid] = jnp.int32(0)        # gcnt 0, no epoch
+            pcsc_u[u][nid] = jnp.int32(0)
             # slot 0 must be initialized: a zero-pred node's traceback
             # diag code still reads mirror slot 0 (cnt-bounded readers
             # cover slots >= 1 only)
             predsm_u[u][(nid) * 8 + 0] = jnp.int32(-1)
-            pcnt_u[u][nid] = jnp.int32(0)
-            scnt_u[u][nid] = jnp.int32(0)
-            gcnt_u[u][nid] = jnp.int32(0)
-            minsucc_u[u][nid] = _INF32
             regs_u[u][2] = nid + 1
             insert_after(u, pos, nid)
 
@@ -438,12 +524,14 @@ def _kernel(nlay_ref, bblen_ref,
 
     def add_edge(u, nu, t, w):
         """poa_graph.hpp add_edge: accumulate weight on an existing
-        nu->t edge else append.  The accumulate (the per-path-step hot
-        case) is pure SMEM: the hit search walks t's <=8-slot PRED id
-        mirror (scalar reads, no vector->scalar sync; in-degree is 1
-        for most nodes so the first probe usually decides).  Only the
-        pred-side weight exists: consensus scores in-edges only."""
-        pc_ = pcnt_u[u][t]
+        nu->t edge else append.  The hit search walks t's <=8-slot
+        PRED id mirror in SMEM (scalar reads, no vector->scalar sync;
+        in-degree is 1 for most nodes so the first probe usually
+        decides); the weight accumulate is a masked vector add on the
+        node's VMEM weight row -- no scalar extraction either way.
+        Only the pred-side weight exists: consensus scores in-edges
+        only."""
+        pc_ = lo16(pcsc_u[u][t])
         found = jnp.int32(-1)
         for pp in range(7, -1, -1):     # descending: first hit wins
             found = jnp.where((pp < pc_) &
@@ -461,23 +549,17 @@ def _kernel(nlay_ref, bblen_ref,
         hit = lax.cond((found < 0) & (pc_ > 8), deep_search,
                        mirror_hit, 0)
 
-        @pl.when(hit < 8)
+        @pl.when(hit < p)
         def _():
-            hp = t * 8 + hit
-            predw_u[u][hp] = predw_u[u][hp] + w
-
-        @pl.when((hit >= 8) & (hit < p))
-        def _():
-            # spilled slot (in-degree > 8, rare): weight row in VMEM
             wrow = vload(predwv_u[u], t)
             predwv_u[u][pl.ds(t, 1), :] = jnp.where(
-                iota_pw == hit - 8, wrow + w, wrow)
+                iota_p == hit, wrow + w, wrow)
 
         @pl.when(hit >= p)
         def _():
-            free = scnt_u[u][nu]
+            free = hi16(pcsc_u[u][nu])
             prow = vload(preds_u[u], t)
-            pfree = pcnt_u[u][t]
+            pfree = lo16(pcsc_u[u][t])
             okk = (free < s_) & (pfree < p)
 
             @pl.when(okk)
@@ -485,23 +567,21 @@ def _kernel(nlay_ref, bblen_ref,
                 srow = vload(succs_u[u], nu)
                 succs_u[u][pl.ds(nu, 1), :] = jnp.where(
                     iota_s == free, t, srow)
-                minsucc_u[u][nu] = jnp.minimum(minsucc_u[u][nu],
-                                                  anch_u[u][t])
+                wam = anms_u[u][nu]
+                ms = jnp.minimum(hi16(wam), lo16(anms_u[u][t]))
+                anms_u[u][nu] = (wam & M16) | (ms << 16)
                 preds_u[u][pl.ds(t, 1), :] = jnp.where(
                     iota_p == pfree, nu, prow)
-                scnt_u[u][nu] = free + 1
-                pcnt_u[u][t] = pfree + 1
+                pcsc_u[u][nu] = (pcsc_u[u][nu] & M16) \
+                    | ((free + 1) << 16)
+                pcsc_u[u][t] = (pcsc_u[u][t] & NM16) | (pfree + 1)
+                wrow = vload(predwv_u[u], t)
+                predwv_u[u][pl.ds(t, 1), :] = jnp.where(
+                    iota_p == pfree, w, wrow)
 
                 @pl.when(pfree < 8)
                 def _():
-                    predw_u[u][(t) * 8 + 0 + pfree] = w
                     predsm_u[u][(t) * 8 + 0 + pfree] = nu
-
-                @pl.when(pfree >= 8)
-                def _():
-                    wrow = vload(predwv_u[u], t)
-                    predwv_u[u][pl.ds(t, 1), :] = jnp.where(
-                        iota_pw == pfree - 8, w, wrow)
 
             @pl.when(jnp.logical_not(okk) & (regs_u[u][0] == 0))
             def _():
@@ -548,7 +628,11 @@ def _kernel(nlay_ref, bblen_ref,
             # rank-based from the carried in-subset counter: sq is
             # monotone along the topo list, so a successor's band
             # never lags any predecessor's (the dq >= 0 invariant).
-            end_eff_u = [jnp.where(fsp_u[u] > 0, _INF32 - 1, end_u[u])
+            # full-span sentinel is 0xFFFE: minsucc is a 16-bit field
+            # now, real anchors are <= lp << 0xFFFE, and only the
+            # 0xFFFF no-successor sentinel exceeds it
+            end_eff_u = [jnp.where(fsp_u[u] > 0,
+                                   jnp.int32(0xFFFE), end_u[u])
                          for u in range(S)]
             smax_u = [(jnp.maximum(m_u[u] + 1 - wb, 0) + q - 1) // q
                       for u in range(S)]
@@ -579,7 +663,7 @@ def _kernel(nlay_ref, bblen_ref,
 
             def slot_meta(u, pid, cnt, t):
                 """(epoch-valid, band-start) for one pred slot."""
-                be = bandq_u[u][jnp.clip(pid, 0, v - 1)]
+                be = hi16(gcbq_u[u][jnp.clip(pid, 0, v - 1)])
                 valid = (t < cnt) & (pid >= 0) & ((be >> 8) == d)
                 return valid, jnp.where(valid, be & 255, 0)
 
@@ -629,17 +713,18 @@ def _kernel(nlay_ref, bblen_ref,
                 prologs run back to back in one basic block."""
                 live = node >= 0
                 nodec = jnp.maximum(node, 0)
-                anc = anch_u[u][nodec]
+                wam = anms_u[u][nodec]
+                anc = lo16(wam)
                 in_sub = live & act_u[u] & (
                     (fsp_u[u] > 0) |
                     ((anc >= begin_u[u]) & (anc <= end_u[u])))
-                cnt = pcnt_u[u][nodec]
+                cnt = lo16(pcsc_u[u][nodec])
                 # subset SINKS snap to the last quantum: their row is
                 # only ever read at column m - s_r (the inline sink
                 # fold below), and the floor-quantized interpolation
                 # can misplace by up to q-1 columns, which at narrow
                 # bands would push the end column out of reach
-                is_sink_n = minsucc_u[u][nodec] > end_eff_u[u]
+                is_sink_n = hi16(wam) > end_eff_u[u]
                 sq_r = jnp.where(
                     is_sink_n, smax_u[u],
                     jnp.clip(
@@ -684,7 +769,8 @@ def _kernel(nlay_ref, bblen_ref,
                                     + jnp.where(bad3, 1, 0)),
                             deep=cnt > 4,
                             nxt=jnp.where(live & act_u[u],
-                                          nxt_u[u][nodec], -1),
+                                          lo16(nxgl_u[u][nodec]) - 1,
+                                          -1),
                             nvis2=nvis + jnp.where(in_sub, 1, 0))
 
             def dp_deep(u, st):
@@ -737,8 +823,8 @@ def _kernel(nlay_ref, bblen_ref,
                                            st["argf"]))
                 sb = chars_v[u:u + 1, pl.ds(pl.multiple_of(s_r, q),
                                             wb)]
-                sub_u = jnp.where(sb == base_u[u][nodec], matchf,
-                                  mismatchf)
+                sub_u = jnp.where(sb == lo16(bnsq_u[u][nodec]),
+                                  matchf, mismatchf)
                 dmax_u = accu + sub_u
                 vmax = accu + gapf
                 dmax = jnp.pad(dmax_u, ((0, 0), (1, 0)),
@@ -777,7 +863,8 @@ def _kernel(nlay_ref, bblen_ref,
                 @pl.when(in_sub)
                 def _():
                     ring_u[u][pl.ds(nodec, 1), :] = hpk
-                    bandq_u[u][nodec] = (d << 8) | sq_r
+                    gcbq_u[u][nodec] = (gcbq_u[u][nodec] & M16) \
+                        | (((d << 8) | sq_r) << 16)
 
                     @pl.when(nbad > 0)
                     def _():
@@ -811,18 +898,24 @@ def _kernel(nlay_ref, bblen_ref,
                 # phase-by-phase across ALL windows: each phase's S
                 # bodies are emitted back to back in one straight-line
                 # region so the VLIW scheduler can interleave the
-                # independent chains (the whole point of grouping)
-                sts = [dp_pre(u, c[2 * u], c[2 * u + 1])
-                       for u in range(S)]
-                for u in range(S):
-                    dp_deep(u, sts[u])
-                es = [dp_epi(u, sts[u]) for u in range(S)]
-                for u in range(S):
-                    dp_store(u, sts[u], *es[u])
-                out = []
-                for u in range(S):
-                    out.extend((sts[u]["nxt"], sts[u]["nvis2"]))
-                return tuple(out)
+                # independent chains (the whole point of grouping).
+                # Multi-rank stepping: krank ranks of every window per
+                # iteration -- backbone runs of single-pred nodes (the
+                # common case) keep every unrolled step productive,
+                # and inert tail steps (node -1) are fully gated
+                c = list(c)
+                for _kr in range(krank):
+                    sts = [dp_pre(u, c[2 * u], c[2 * u + 1])
+                           for u in range(S)]
+                    for u in range(S):
+                        dp_deep(u, sts[u])
+                    es = [dp_epi(u, sts[u]) for u in range(S)]
+                    for u in range(S):
+                        dp_store(u, sts[u], *es[u])
+                    for u in range(S):
+                        c[2 * u] = sts[u]["nxt"]
+                        c[2 * u + 1] = sts[u]["nvis2"]
+                return tuple(c)
 
             head_u = [jnp.where(act_u[u], regs_u[u][1], -1)
                       for u in range(S)]
@@ -858,7 +951,7 @@ def _kernel(nlay_ref, bblen_ref,
                 extract, the latency to hide); both windows' pres run
                 in one block."""
                 nodec = jnp.maximum(node, 0)
-                be = bandq_u[u][nodec]
+                be = hi16(gcbq_u[u][nodec])
                 s0 = jnp.where(node >= 0, be & 255, 0) * q
                 cc = jnp.clip(jj - s0, 0, wb - 1)
                 drow = ring_u[u][pl.ds(nodec, 1), :]
@@ -889,7 +982,7 @@ def _kernel(nlay_ref, bblen_ref,
 
                 pid = lax.cond(slot >= 8, deep, keep, 0)
                 pvalid = (pid >= 0) & \
-                    ((bandq_u[u][jnp.clip(pid, 0, v - 1)] >> 8)
+                    ((hi16(gcbq_u[u][jnp.clip(pid, 0, v - 1)]) >> 8)
                      == d)
                 pnode = jnp.where(pvalid, pid, -1)
                 en = jnp.where(take, node, -1)
@@ -960,7 +1053,7 @@ def _kernel(nlay_ref, bblen_ref,
                 # when the result is masked out
                 c, w = chw_at(u, jnp.clip(jj, 0, lp - 1))
                 fast = has & (nid >= 0) & \
-                    (base_u[u][jnp.clip(nid, 0, v - 1)] == c)
+                    (lo16(bnsq_u[u][jnp.clip(nid, 0, v - 1)]) == c)
                 return dict(prev=prev, prev_w=prev_w, nid=nid,
                             has=has, c=c, w=w, fast=fast)
 
@@ -979,10 +1072,10 @@ def _kernel(nlay_ref, bblen_ref,
                     def t_new(_):
                         anchor = jnp.where(
                             prev < 0, begin_u[u],
-                            anch_u[u][jnp.maximum(prev, 0)])
+                            lo16(anms_u[u][jnp.maximum(prev, 0)]))
                         pos = jnp.where(
                             prev < 0, -1,
-                            glast_u[u][jnp.maximum(prev, 0)])
+                            hi16(nxgl_u[u][jnp.maximum(prev, 0)]))
                         return new_node(u, c, anchor, pos)
 
                     def t_aligned(_):
@@ -994,7 +1087,7 @@ def _kernel(nlay_ref, bblen_ref,
                         # vector compare + extract, and group members
                         # have distinct bases by construction so at
                         # most one entry matches
-                        gc = gcnt_u[u][nid]
+                        gc = lo16(gcbq_u[u][nid])
                         arow = vload(aligsm_u[u], nid)
                         h = e11(jnp.min(jnp.where(
                             (arow % 256 == c) & (iota_a < gc),
@@ -1002,8 +1095,9 @@ def _kernel(nlay_ref, bblen_ref,
                         found = jnp.where(h < v, h, -1)
 
                         def mk_new(_):
-                            tgt = new_node(u, c, anch_u[u][nid],
-                                           glast_u[u][nid])
+                            tgt = new_node(
+                                u, c, lo16(anms_u[u][nid]),
+                                hi16(nxgl_u[u][nid]))
 
                             @pl.when(gc >= a_)
                             def _():
@@ -1013,11 +1107,12 @@ def _kernel(nlay_ref, bblen_ref,
                             @pl.when(gc < a_)
                             def _():
                                 # tgt's group = nid's members + nid
-                                nb = base_u[u][nid]
+                                nb = lo16(bnsq_u[u][nid])
                                 aligsm_u[u][pl.ds(tgt, 1), :] = \
                                     jnp.where(iota_a == gc,
                                               nid * 256 + nb, arow)
-                                gcnt_u[u][tgt] = gc + 1
+                                gcbq_u[u][tgt] = \
+                                    (gcbq_u[u][tgt] & NM16) | (gc + 1)
 
                                 # append tgt to each member (groups
                                 # already full skip the append)
@@ -1025,7 +1120,7 @@ def _kernel(nlay_ref, bblen_ref,
                                     sib = e11(jnp.sum(jnp.where(
                                         iota_a == aa, arow, 0),
                                         axis=1, keepdims=True)) // 256
-                                    gs = gcnt_u[u][sib]
+                                    gs = lo16(gcbq_u[u][sib])
 
                                     @pl.when(gs < a_)
                                     def _():
@@ -1035,16 +1130,23 @@ def _kernel(nlay_ref, bblen_ref,
                                             :] = jnp.where(
                                                 iota_a == gs,
                                                 tgt * 256 + c, srw)
-                                        gcnt_u[u][sib] = gs + 1
-                                    glast_u[u][sib] = tgt
+                                        gcbq_u[u][sib] = \
+                                            (gcbq_u[u][sib] & NM16) \
+                                            | (gs + 1)
+                                    nxgl_u[u][sib] = \
+                                        (nxgl_u[u][sib] & M16) \
+                                        | (tgt << 16)
                                     return 0
 
                                 lax.fori_loop(0, gc, ap, 0)
                                 aligsm_u[u][pl.ds(nid, 1), :] = \
                                     jnp.where(iota_a == gc,
                                               tgt * 256 + c, arow)
-                                gcnt_u[u][nid] = gc + 1
-                                glast_u[u][nid] = tgt
+                                gcbq_u[u][nid] = \
+                                    (gcbq_u[u][nid] & NM16) | (gc + 1)
+                                nxgl_u[u][nid] = \
+                                    (nxgl_u[u][nid] & M16) \
+                                    | (tgt << 16)
                             return tgt
 
                         return lax.cond(found >= 0, lambda _: found,
@@ -1057,7 +1159,9 @@ def _kernel(nlay_ref, bblen_ref,
 
                 @pl.when(has)
                 def _():
-                    nseq_u[u][target] = nseq_u[u][target] + 1
+                    # nseq is the hi half of bnsq: +1<<16 bumps it
+                    # without touching the base half
+                    bnsq_u[u][target] = bnsq_u[u][target] + (1 << 16)
 
                     @pl.when(prev >= 0)
                     def _():
@@ -1098,48 +1202,50 @@ def _kernel(nlay_ref, bblen_ref,
 
         @pl.when(fail == 0)
         def _consensus(u=u):
-            # walk the list once for a full topo order
+            # walk the list once for a full topo order; order reuses
+            # the glast half of nxgl (group-last is dead by now), so
+            # each step is one RMW store next to the lo-half nxt read
             def wcond(c):
                 return c[0] >= 0
 
             def wbody(c):
                 node, r = c
-                order_u[u][r] = node
-                return nxt_u[u][node], r + 1
+                nxgl_u[u][r] = (nxgl_u[u][r] & M16) | (node << 16)
+                return lo16(nxgl_u[u][node]) - 1, r + 1
 
             _, n_all = lax.while_loop(wcond, wbody,
                                       (regs_u[u][1], jnp.int32(0)))
 
             # forward DP: per node pick the heaviest in-edge (ties ->
             # higher predecessor score; slot order = insertion order,
-            # matching poa_graph.hpp consensus_path)
+            # matching poa_graph.hpp consensus_path).  Scores need the
+            # full 32 bits, so they alias the path tape (dead until
+            # the backtrack below); weights come off the node's VMEM
+            # row, loaded once per node
             def cdp(r, best_sink):
-                node = order_u[u][r]
-                cnt = pcnt_u[u][node]
+                node = hi16(nxgl_u[u][r])
+                cnt = lo16(pcsc_u[u][node])
+                wrow = vload(predwv_u[u], node)
 
                 def pick(t, carry):
                     bu, bw = carry
                     tc = jnp.clip(t, 0, 7)
                     pidm = predsm_u[u][(node) * 8 + 0 + tc]
-                    wm = predw_u[u][(node) * 8 + 0 + tc]
 
                     def deep(_):
-                        # spilled slot: id from the VMEM row, weight
-                        # from the VMEM spill row
+                        # spilled slot: id from the VMEM row
                         prow = vload(preds_u[u], node)
-                        wrow = vload(predwv_u[u], node)
-                        pid = e11(jnp.sum(
+                        return e11(jnp.sum(
                             jnp.where(iota_p == t, prow, 0), axis=1,
                             keepdims=True))
-                        wv = e11(jnp.sum(
-                            jnp.where(iota_pw == t - 8, wrow, 0),
-                            axis=1, keepdims=True))
-                        return pid, wv
 
                     def keep(_):
-                        return pidm, wm
+                        return pidm
 
-                    pid, w = lax.cond(t >= 8, deep, keep, 0)
+                    pid = lax.cond(t >= 8, deep, keep, 0)
+                    w = e11(jnp.sum(
+                        jnp.where(iota_p == t, wrow, 0), axis=1,
+                        keepdims=True))
                     sc = score_u[u][jnp.maximum(pid, 0)]
                     bsc = score_u[u][jnp.maximum(bu, 0)]
                     tk = (pid >= 0) & ((w > bw) |
@@ -1153,8 +1259,10 @@ def _kernel(nlay_ref, bblen_ref,
                 score_u[u][node] = jnp.where(
                     best_u >= 0,
                     score_u[u][jnp.maximum(best_u, 0)] + best_w, 0)
-                cpred_u[u][node] = best_u
-                is_sink = minsucc_u[u][node] >= _INF32
+                # cpred reuses the bandq half of gcbq, biased +1
+                # (0 = no predecessor); gcnt is dead, overwrite whole
+                gcbq_u[u][node] = (best_u + 1) << 16
+                is_sink = hi16(anms_u[u][node]) >= _INF16
                 better = is_sink & (
                     (best_sink < 0) |
                     (score_u[u][node] >
@@ -1169,8 +1277,11 @@ def _kernel(nlay_ref, bblen_ref,
 
             def bbody(c):
                 node, ln = c
+                # the path store may clobber score slots, but the
+                # forward DP above made its last score read; the
+                # chain itself lives in the gcbq cpred half
                 path_u[u][ln] = (node + 2) * pkr + 2
-                return cpred_u[u][node], ln + 1
+                return hi16(gcbq_u[u][node]) - 1, ln + 1
 
             _, clen = lax.while_loop(bcond, bbody,
                                      (best_sink, jnp.int32(0)))
@@ -1180,13 +1291,13 @@ def _kernel(nlay_ref, bblen_ref,
 
             def scan_fwd(t, first):
                 node = path_u[u][clen - 1 - t] // pkr - 2
-                cov = nseq_u[u][node]
+                cov = hi16(bnsq_u[u][node])
                 hit = (first < 0) & (cov >= avg)
                 return jnp.where(hit, t, first)
 
             def scan_bwd(t, last):
                 node = path_u[u][t] // pkr - 2
-                cov = nseq_u[u][node]
+                cov = hi16(bnsq_u[u][node])
                 hit = (last < 0) & (cov >= avg)
                 return jnp.where(hit, clen - 1 - t, last)
 
@@ -1208,7 +1319,8 @@ def _kernel(nlay_ref, bblen_ref,
             def emit(t, _):
                 node = path_u[u][clen - 1 - (cbegin + t)] \
                     // pkr - 2
-                cons_sm[u, t // 128, t % 128] = base_u[u][node]
+                cons_sm[u, t // 128, t % 128] = \
+                    lo16(bnsq_u[u][node])
                 return 0
 
             lax.fori_loop(0, length, emit, 0)
@@ -1226,16 +1338,17 @@ def _kernel(nlay_ref, bblen_ref,
 @functools.partial(
     jax.jit,
     static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18,
-                    19, 20))
+                    19, 20, 21))
 def _poa_full(seqs, wts, meta, nlay, bblen,
               v: int, lp: int, d1: int, p: int, s_: int, a_: int,
               k: int, wb: int, match: int, mismatch: int, gap: int,
-              wtype: int, trim: int, s_win: int = 0,
+              wtype: int, trim: int, s_win: int = 0, krank: int = 0,
               interpret: bool = False, prof: int = 0):
     """seqs/wts: [B, D1, LP] uint8 (d=0 = backbone), meta: [B, D1, 8]
     int32 (begin, end, full_span, slen, ...), nlay/bblen: [B] int32.
     B must be a multiple of the windows-per-program factor ``s_win``
-    (0 = pick the largest that fits).
+    (0 = pick the largest that fits); ``krank`` is the multi-rank
+    stepping factor (0 = policy pick).
     Returns (cons [B, V, 1] int32, mout [B, 8, 1] int32)."""
     b = seqs.shape[0]
     if not s_win:
@@ -1243,18 +1356,23 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
     assert s_win > 0, "shape does not fit the flagship kernel"
     assert b % s_win == 0, \
         f"batch {b} not a multiple of group factor {s_win}"
+    if not krank:
+        krank = pick_rank_unroll(v, lp, d1, p, s_, a_, wb, s_win)
     pkr = 1
     while pkr < lp + 8:
         pkr <<= 1
     assert (v + 2) * pkr < 2 ** 31, "path packing overflows int32"
+    # the packed 16-bit SMEM fields (node ids, anchors, band epochs)
+    # must stay in range; every production cap is far inside these
+    assert v <= 0x8000 and lp < 0xFFFE and d1 <= 256, \
+        "caps overflow the packed 16-bit scalar fields"
     seqs_l = seqs.astype(jnp.int32)
     wts_l = wts.astype(jnp.int32)
 
     kern = functools.partial(
         _kernel, v=v, lp=lp, d1=d1, p=p, s_=s_, a_=a_, k=k, wb=wb,
-        s_win=s_win, match=match, mismatch=mismatch, gap=gap,
-        wtype=wtype, trim=trim, prof=prof)
-    pw = max(p - 8, 1)
+        s_win=s_win, krank=krank, match=match, mismatch=mismatch,
+        gap=gap, wtype=wtype, trim=trim, prof=prof)
     # one ref PER WINDOW so the scheduler can prove the interleaved
     # walks never alias (see _kernel); order must match
     # _SCRATCH_PER_WIN
@@ -1265,21 +1383,15 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
         "accs": pltpu.VMEM((1, wb), jnp.float32),
         "arga": pltpu.VMEM((1, wb), jnp.int32),
         "aligsm": pltpu.VMEM((v, a_), jnp.int32),  # aligned groups
-        "predwv": pltpu.VMEM((v, pw), jnp.int32),  # pred-w spill 8+
-        "base": pltpu.SMEM((v,), jnp.int32),
-        "anch": pltpu.SMEM((v,), jnp.int32),   # aliased: cons score
-        "nseq": pltpu.SMEM((v,), jnp.int32),
-        "nxt": pltpu.SMEM((v,), jnp.int32),
-        "glast": pltpu.SMEM((v,), jnp.int32),  # aliased: cons order
-        "bandq": pltpu.SMEM((v,), jnp.int32),  # aliased: cons pred
-        "pcnt": pltpu.SMEM((v,), jnp.int32),
-        "scnt": pltpu.SMEM((v,), jnp.int32),
+        "predwv": pltpu.VMEM((v, p), jnp.int32),   # pred weights
+        "bnsq": pltpu.SMEM((v,), jnp.int32),
+        "anms": pltpu.SMEM((v,), jnp.int32),
+        "nxgl": pltpu.SMEM((v,), jnp.int32),   # hi half: cons order
+        "pcsc": pltpu.SMEM((v,), jnp.int32),
+        "gcbq": pltpu.SMEM((v,), jnp.int32),   # hi half: cons cpred
         "predsm": pltpu.SMEM((8 * v,), jnp.int32),  # pred id mirror
-        "predw": pltpu.SMEM((8 * v,), jnp.int32),   # pred w slots 0-7
-        "path": pltpu.SMEM((v + lp,), jnp.int32),
-        "gcnt": pltpu.SMEM((v,), jnp.int32),   # aligned count
+        "path": pltpu.SMEM((v + lp,), jnp.int32),   # also cons score
         "regs": pltpu.SMEM((_NREG,), jnp.int32),
-        "minsucc": pltpu.SMEM((v,), jnp.int32),
     }
     assert set(per_win) == set(_SCRATCH_PER_WIN)
     scratch = []
@@ -1335,10 +1447,10 @@ def _poa_full(seqs, wts, meta, nlay, bblen,
     jax.jit,
     static_argnames=("mesh", "v", "lp", "d1", "p", "s_", "a_", "k",
                      "wb", "match", "mismatch", "gap", "wtype", "trim",
-                     "s_win", "interpret"))
+                     "s_win", "krank", "interpret"))
 def _poa_full_sharded(seqs, wts, meta, nlay, bblen, *, mesh,
                       v, lp, d1, p, s_, a_, k, wb,
-                      match, mismatch, gap, wtype, trim, s_win,
+                      match, mismatch, gap, wtype, trim, s_win, krank,
                       interpret):
     """The same kernel sharded over the mesh batch axis with shard_map:
     one compile, XLA places one grid per device, no collectives — the
@@ -1350,7 +1462,7 @@ def _poa_full_sharded(seqs, wts, meta, nlay, bblen, *, mesh,
         return _poa_full(seqs, wts, meta, nlay, bblen,
                          v, lp, d1, p, s_, a_, k, wb,
                          match, mismatch, gap, wtype, trim, s_win,
-                         interpret)
+                         krank, interpret)
 
     return shard_batch_map(shard_fn, mesh, 5, 2)(
         seqs, wts, meta, nlay, bblen)
@@ -1394,6 +1506,9 @@ def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
     With a multi-device ``mesh`` the batch axis is sharded across the
     devices (callers pad the batch; this pads further to a mesh-and-
     group multiple with inert 1-base windows)."""
+    import threading
+    import time
+
     from racon_tpu.parallel.mesh_utils import interpret_mode
 
     n_dev = len(mesh.devices) if mesh is not None else 1
@@ -1401,22 +1516,24 @@ def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
     b0 = seqs.shape[0]
     s_win = pick_windows_per_program(v, lp, d1, p, s, a, wb)
     assert s_win > 0, "shape does not fit the flagship kernel"
+    krank = pick_rank_unroll(v, lp, d1, p, s, a, wb, s_win)
     mult = s_win * n_dev
     if b0 % mult:
         seqs, wts, meta, nlay, bblen = _pad_pairs(
             seqs, wts, meta, nlay, bblen, mult)
+    t_disp = time.monotonic()
     if n_dev > 1:
         cons, mout = _poa_full_sharded(
             jnp.asarray(seqs), jnp.asarray(wts), jnp.asarray(meta),
             jnp.asarray(nlay), jnp.asarray(bblen), mesh=mesh,
             v=v, lp=lp, d1=d1, p=p, s_=s, a_=a, k=k, wb=wb,
             match=match, mismatch=mismatch, gap=gap, wtype=wtype,
-            trim=trim, s_win=s_win, interpret=interp)
+            trim=trim, s_win=s_win, krank=krank, interpret=interp)
     else:
         from racon_tpu.utils import aot_shelf
 
         statics = (v, lp, d1, p, s, a, k, wb, match, mismatch, gap,
-                   wtype, trim, s_win, interp)
+                   wtype, trim, s_win, krank, interp)
 
         def build(se, wt, me, nl, bb):
             return _poa_full(se, wt, me, nl, bb, *statics)
@@ -1431,10 +1548,32 @@ def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
     cons.copy_to_host_async()
     mout.copy_to_host_async()
 
+    # host-independent per-dispatch device time: a watcher thread
+    # blocks on the outputs the moment the dispatch is enqueued, so
+    # the measured span (upload + kernel + download) cannot be
+    # inflated by whatever the host does between dispatch and collect
+    # (the two-deep pipeline packs the NEXT megabatch there) -- the
+    # bench's poa_device_s, distinguishing kernel regressions from
+    # host jitter (VERDICT r5 #8)
+    span = {}
+
+    def _watch():
+        try:
+            jax.block_until_ready((cons, mout))
+            span["s"] = time.monotonic() - t_disp
+        except Exception:
+            pass  # dispatch errors surface at collect()
+
+    watcher = threading.Thread(target=_watch, daemon=True,
+                               name="racon-poa-devtime")
+    watcher.start()
+
     def collect():
         # slice off pad rows: the contract is [B, ...]
         c = np.asarray(cons)
+        watcher.join()
         return (c.reshape(c.shape[0], -1)[:b0, :],
                 np.asarray(mout)[:b0, :, 0])
 
+    collect.device_s = lambda: span.get("s", 0.0)
     return collect

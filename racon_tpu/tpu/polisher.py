@@ -130,6 +130,11 @@ class TPUPolisher(Polisher):
         self.poa_device_windows = 0
         self.poa_eligible_windows = 0
         self.stage_walls = {}
+        # host-independent per-dispatch device time (watcher-thread
+        # spans), distinguishing kernel regressions from host jitter
+        # in bench records (VERDICT r5 #8)
+        self.poa_device_s = 0.0
+        self.align_device_s = 0.0
         from racon_tpu.utils.xla_cache import enable_compilation_cache
         enable_compilation_cache()
 
@@ -291,10 +296,11 @@ class TPUPolisher(Polisher):
             # deterministic rate-model argmin (like the align stage)
             # at SELF-CALIBRATED us/unit rates: measured on this
             # machine by a previous run and persisted next to the XLA
-            # cache (r3-hardware defaults until then; env pins for
-            # golden CI configs) -- racon_tpu/utils/calibrate.py
+            # cache (defaults reflect the r6 kernel until then; env
+            # pins for golden CI configs) -- racon_tpu/utils/calibrate
             r_dev, r_cpu, r_src = calibrate.get_rates(
-                "poa", n_dev, 0.30, 2.0)
+                "poa", n_dev, self.POA_DEV_US_PER_UNIT,
+                self.POA_CPU_US_PER_UNIT)
             dev_left = _rate_split(
                 [unit_of[i] * r_dev / n_dev for i in eligible],
                 [unit_of[i] * r_cpu / n_workers for i in eligible])
@@ -409,18 +415,26 @@ class TPUPolisher(Polisher):
         # rate schedules far better than the frozen default a
         # small-job-only machine would otherwise keep forever
         # (measured r5: the sample's POA split never left 32/96
-        # because the drop left zero recorded megabatches).
+        # because the drop left zero recorded megabatches).  Such
+        # single-megabatch samples store PROVISIONALLY: they never
+        # freeze the calibration, so a later multi-megabatch run can
+        # still overwrite them (ADVICE r5: two small jobs froze a
+        # dispatch-latency-biased split at generation 2).
         recorded = meas["dev"][1:] if len(meas["dev"]) > 1 \
             else meas["dev"]
         dev_w = sum(w for w, _ in recorded)
         dev_u = sum(u for _, u in recorded)
-        _, _, _src = calibrate.get_rates("poa", n_dev, 0.30, 2.0)
+        _, _, _src = calibrate.get_rates(
+            "poa", n_dev, self.POA_DEV_US_PER_UNIT,
+            self.POA_CPU_US_PER_UNIT)
         if dev_u > 0 and meas["cpu_u"] > 0 and _src != "env":
             # env-pinned runs (CI, tests) never mutate the machine's
             # calibration cache
             calibrate.store_rates(
                 "poa", n_dev, dev_w * 1e6 * n_dev / dev_u,
-                meas["cpu_w"] * 1e6 / meas["cpu_u"])
+                meas["cpu_w"] * 1e6 / meas["cpu_u"],
+                provisional=len(meas["dev"]) <= 1)
+        self.poa_device_s = engine.device_s
         self.poa_cells += engine.cells
         self.poa_reject_counts = dict(engine.reject_counts)
         self.poa_phase_walls = dict(engine.phase_walls)
@@ -526,6 +540,13 @@ class TPUPolisher(Polisher):
     # (racon_tpu/utils/calibrate.py); RACON_TPU_RATE_ALIGN_* pins them
     DEV_NS_PER_ROW = 1100
     CPU_NS_PER_CELL = 4.0
+    # POA defaults (us per cost unit): the device rate tracks the r6
+    # kernel (S=5 interleave + 4-rank stepping, ~2.4x the r5 rate the
+    # old 0.30 default described) so an UNCALIBRATED first run already
+    # hands the device its winning share instead of starving it for a
+    # generation; RACON_TPU_RATE_POA_* pins both
+    POA_DEV_US_PER_UNIT = 0.13
+    POA_CPU_US_PER_UNIT = 2.0
 
     def _device_align_overlaps(self, overlaps: List[Overlap]) -> None:
         pending = []  # (dim, overlap), dim = max span side
@@ -848,10 +869,15 @@ class TPUPolisher(Polisher):
             # tunnel's collect round trip) hides under the next
             # chunk's device compute.  Two chunks are in flight, so
             # each must fit HALF the memory budget for the documented
-            # footprint bound to keep holding
+            # footprint bound to keep holding -- and the per-device
+            # floor must never push the halved chunk back ABOVE the
+            # budget-derived cap (ADVICE r5: on memory-constrained
+            # multi-device configs the unclamped floor let two
+            # in-flight chunks exceed the documented bound)
             if len(idx) > max_b:
-                max_b = max(8 * len(self.mesh.devices),
-                            max_b // 2)
+                max_b = min(max_b,
+                            max(8 * len(self.mesh.devices),
+                                max_b // 2))
             chunks = [idx[c0:c0 + max_b]
                       for c0 in range(0, len(idx), max_b)]
 
@@ -867,6 +893,8 @@ class TPUPolisher(Polisher):
                 nxt = dispatch(chunks[ci + 1]) \
                     if ci + 1 < len(chunks) else None
                 moves, lens, dists = pending_c()
+                self.align_device_s += getattr(
+                    pending_c, "device_s", lambda: 0.0)()
                 pending_c = nxt
                 if hasattr(self, "_align_disp"):
                     now = _time.monotonic()

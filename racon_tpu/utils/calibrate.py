@@ -26,7 +26,9 @@ rate is deterministic-but-wrong.  This module makes them measured:
   when the machine's own measurements put the optimum near 80%.
   Splits still converge because stores freeze at generation 2;
   in-run determinism checks must therefore compare runs made AFTER
-  the freeze (bench.py runs one settling pass first).
+  the freeze (bench.py runs one settling pass first).  Low-confidence
+  samples (single-megabatch runs) store as ``provisional`` and never
+  freeze -- see ``store_rates``.
 """
 
 from __future__ import annotations
@@ -98,7 +100,7 @@ def get_rates(stage: str, n_dev: int, default_dev: float,
 
 
 def store_rates(stage: str, n_dev: int, dev_rate: float,
-                cpu_rate=None) -> None:
+                cpu_rate=None, provisional: bool = False) -> None:
     """Persist measured rates (two-pass-then-frozen per machine key +
     stage; RACON_TPU_RECALIBRATE=1 always overwrites).  The FIRST
     measurement runs under the conservative default split, which
@@ -109,7 +111,16 @@ def store_rates(stage: str, n_dev: int, dev_rate: float,
     rate only -- used by stages whose CPU cost model does not transfer
     across workloads (the aligner's d^2 model fitted on one dataset's
     tail misprices another's divergence), so the measured device rate
-    combines with the conservative CPU default.  Never raises."""
+    combines with the conservative CPU default.
+
+    ``provisional`` marks a low-confidence sample (e.g. a single-
+    megabatch run whose one interval carries the full dispatch
+    latency): it stays at generation 1 forever -- never freezing the
+    entry -- and never replaces a non-provisional measurement, so a
+    machine that only ever runs small jobs keeps recalibrating until
+    a real multi-megabatch sample lands (ADVICE r5: two equally
+    biased small-job samples used to freeze at generation 2).  Never
+    raises."""
     if not dev_rate > 0 or (cpu_rate is not None and not cpu_rate > 0):
         return
     try:
@@ -126,11 +137,22 @@ def store_rates(stage: str, n_dev: int, dev_rate: float,
                 pass
             ent = data.setdefault(mkey, {})
             old = ent.get(stage)
-            if old and old.get("gen", 1) >= 2 and \
-                    not os.environ.get("RACON_TPU_RECALIBRATE"):
+            recal = os.environ.get("RACON_TPU_RECALIBRATE")
+            old_real = old and not old.get("provisional")
+            if old_real and old.get("gen", 1) >= 2 and not recal:
                 return
-            gen = old.get("gen", 1) + 1 if old else 1
+            if provisional and old_real and not recal:
+                # a low-confidence sample must not degrade a real one
+                return
+            if provisional:
+                gen = 1
+            else:
+                # a real sample after provisional ones starts its own
+                # two-pass sequence at generation 1
+                gen = old.get("gen", 1) + 1 if old_real else 1
             ent[stage] = {"dev": round(dev_rate, 4), "gen": gen}
+            if provisional:
+                ent[stage]["provisional"] = True
             if cpu_rate is not None:
                 ent[stage]["cpu"] = round(cpu_rate, 4)
             os.makedirs(os.path.dirname(path), exist_ok=True)

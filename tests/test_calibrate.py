@@ -94,3 +94,31 @@ def test_dev_only_store_keeps_cpu_default(calib_dir):
     calibrate.store_rates("align", 1, 800.0)
     dev, cpu, src = calibrate.get_rates("align", 1, 1100.0, 4.0)
     assert (dev, cpu, src) == (pytest.approx(800.0), 4.0, "calibrated")
+
+
+def test_provisional_stores_never_freeze(calib_dir):
+    """Single-megabatch samples are provisional: any number of them
+    keeps overwriting (a small-job-only machine never freezes a
+    dispatch-latency-biased split), and a later real multi-megabatch
+    measurement replaces them and starts its own two-pass sequence."""
+    calibrate.store_rates("poa", 1, 0.9, 3.0, provisional=True)
+    calibrate.store_rates("poa", 1, 0.8, 2.9, provisional=True)
+    calibrate.store_rates("poa", 1, 0.7, 2.8, provisional=True)
+    dev, _, src = calibrate.get_rates("poa", 1, 0.13, 2.0)
+    assert (dev, src) == (pytest.approx(0.7), "calibrated")
+    # a real sample overwrites the provisional one...
+    calibrate.store_rates("poa", 1, 0.2, 2.0)
+    dev, _, _ = calibrate.get_rates("poa", 1, 0.13, 2.0)
+    assert dev == pytest.approx(0.2)
+    # ...refines once, then freezes as usual
+    calibrate.store_rates("poa", 1, 0.25, 2.1)
+    calibrate.store_rates("poa", 1, 9.9, 9.9)
+    dev, _, _ = calibrate.get_rates("poa", 1, 0.13, 2.0)
+    assert dev == pytest.approx(0.25)
+
+
+def test_provisional_never_degrades_real_sample(calib_dir):
+    calibrate.store_rates("poa", 1, 0.2, 2.0)     # real, gen 1
+    calibrate.store_rates("poa", 1, 5.0, 9.0, provisional=True)
+    dev, _, _ = calibrate.get_rates("poa", 1, 0.13, 2.0)
+    assert dev == pytest.approx(0.2)

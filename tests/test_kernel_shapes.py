@@ -12,27 +12,48 @@ from racon_tpu.tpu import align_pallas, poa_pallas
 
 
 @pytest.fixture(autouse=True)
-def _no_swin_override(monkeypatch):
-    # a developer's exported RACON_TPU_POA_SWIN must not fail the
-    # stock-policy pins; the override test sets it explicitly
+def _no_kernel_overrides(monkeypatch):
+    # a developer's exported RACON_TPU_POA_SWIN / _KRANK must not fail
+    # the stock-policy pins; the override tests set them explicitly
     monkeypatch.delenv("RACON_TPU_POA_SWIN", raising=False)
+    monkeypatch.delenv("RACON_TPU_POA_KRANK", raising=False)
 
 
 def test_windows_per_program_stock_configs():
-    # stock w=500 caps fit three windows per program; w=1000 caps one
+    # after the r6 SMEM diet (5 packed scalar arrays + VMEM pred
+    # weights) the stock w=500 caps fit FIVE windows per program and
+    # the w=1000 caps two
     wb500 = poa_pallas.band_width(1024)
     assert wb500 == 256
     assert poa_pallas.pick_windows_per_program(
-        2048, 1024, 32, 16, 16, 8, wb500) == 3
+        2048, 1024, 32, 16, 16, 8, wb500) == 5
+    # deep megabatches (d1=64) keep the same factor
+    assert poa_pallas.pick_windows_per_program(
+        2048, 1024, 64, 16, 16, 8, wb500) == 5
     wb1000 = poa_pallas.band_width(2048)
     assert wb1000 == 512
     assert poa_pallas.pick_windows_per_program(
-        4096, 2048, 32, 16, 16, 8, wb1000) == 1
-    # the banded w=1000 band (256 cols) also runs at S=1
+        4096, 2048, 32, 16, 16, 8, wb1000) == 2
+    # the banded w=1000 band (256 cols) also runs at S=2 (SMEM binds,
+    # not the band-width-dependent VMEM)
     wb1000b = poa_pallas.band_width(2048, banded=True)
     assert wb1000b == 256
     assert poa_pallas.pick_windows_per_program(
-        4096, 2048, 32, 16, 16, 8, wb1000b) == 1
+        4096, 2048, 32, 16, 16, 8, wb1000b) == 2
+
+
+def test_rank_unroll_stock_configs():
+    # multi-rank stepping: both stock shapes take the full 4-rank
+    # unroll next to their windows-per-program pick
+    assert poa_pallas.pick_rank_unroll(
+        2048, 1024, 32, 16, 16, 8, 256, s_win=5) == 4
+    assert poa_pallas.pick_rank_unroll(
+        4096, 2048, 32, 16, 16, 8, 512, s_win=2) == 4
+    # no flagship kernel -> no unroll decision to make
+    assert poa_pallas.pick_rank_unroll(
+        2048, 1024, 32, 16, 16, 8, 256, s_win=0) == 4
+    assert poa_pallas.pick_rank_unroll(
+        2048, 1024, 32, 16, 16, 8, 256, s_win=-1) == 1
 
 
 def test_windows_per_program_env_override(monkeypatch):
@@ -40,20 +61,49 @@ def test_windows_per_program_env_override(monkeypatch):
     assert poa_pallas.pick_windows_per_program(
         2048, 1024, 32, 16, 16, 8, 256) == 2
     # a forced factor that does not fit reports 0 (caller falls back)
+    # and WARNS instead of silently routing to the lockstep engine
     monkeypatch.setenv("RACON_TPU_POA_SWIN", "8")
-    assert poa_pallas.pick_windows_per_program(
-        2048, 1024, 32, 16, 16, 8, 256) == 0
+    with pytest.warns(RuntimeWarning, match="RACON_TPU_POA_SWIN"):
+        assert poa_pallas.pick_windows_per_program(
+            2048, 1024, 32, 16, 16, 8, 256) == 0
+
+
+def test_windows_per_program_env_validation(monkeypatch):
+    # malformed values fail loudly, naming the variable
+    monkeypatch.setenv("RACON_TPU_POA_SWIN", "three")
+    with pytest.raises(ValueError, match="RACON_TPU_POA_SWIN"):
+        poa_pallas.pick_windows_per_program(2048, 1024, 32)
+    monkeypatch.setenv("RACON_TPU_POA_SWIN", "0")
+    with pytest.raises(ValueError, match="RACON_TPU_POA_SWIN"):
+        poa_pallas.pick_windows_per_program(2048, 1024, 32)
+
+
+def test_rank_unroll_env_override(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_POA_KRANK", "2")
+    assert poa_pallas.pick_rank_unroll(
+        2048, 1024, 32, 16, 16, 8, 256, s_win=5) == 2
+    # a forced unroll the budget rejects warns and falls back to the
+    # policy pick instead of disabling the kernel
+    monkeypatch.setenv("RACON_TPU_POA_KRANK", "8")
+    with pytest.warns(RuntimeWarning, match="RACON_TPU_POA_KRANK"):
+        assert poa_pallas.pick_rank_unroll(
+            2048, 1024, 32, 16, 16, 8, 256, s_win=5) == 4
+    monkeypatch.setenv("RACON_TPU_POA_KRANK", "nope")
+    with pytest.raises(ValueError, match="RACON_TPU_POA_KRANK"):
+        poa_pallas.pick_rank_unroll(2048, 1024, 32, s_win=5)
 
 
 def test_padded_batch_matches_dispatch_multiples():
-    # w=500 class: s_win=3, one device -> multiples of 3
-    for b, want in ((64, 66), (32, 33), (256, 258), (66, 66)):
+    # w=500 class: s_win=5, one device -> multiples of 5
+    for b, want in ((64, 65), (32, 35), (256, 260), (65, 65)):
         assert poa_pallas.padded_batch(b, 1, 2048, 1024, 32) == want
-    # w=1000 class: s_win=1 -> identity
+    # w=1000 class: s_win=2 -> even batches pass through
     assert poa_pallas.padded_batch(
         32, 1, 4096, 2048, 32, wb=512) == 32
+    assert poa_pallas.padded_batch(
+        31, 1, 4096, 2048, 32, wb=512) == 32
     # mesh multiple folds in
-    assert poa_pallas.padded_batch(64, 8, 2048, 1024, 32) == 72
+    assert poa_pallas.padded_batch(64, 8, 2048, 1024, 32) == 80
 
 
 def test_align_pad_pairs_floor():

@@ -5,10 +5,12 @@ own pinned values (test/racon_test.cpp:292-496: the CUDA pins sit next
 to the CPU ones, e.g. `:312` 1385 vs CPU 1312, and `:400` records the
 w=1000 config where the CUDA path craters to 4168 vs the CPU's 1289).
 Round 4's verdict flagged that our device path was pinned on exactly
-one config; this file pins it across the matrix: window length 1000
-(exercises the S=1 flagship-kernel path that replaced the lockstep
-fail-over), edit-distance scores 1/-1/-1, SAM input with and without
-qualities, FASTA input, and fragment-correction mode.
+one config; this file pins it across the full 10-config matrix
+(racon_test.cpp:434-494 analog): window length 1000 (exercises the
+S=2 flagship-kernel path that replaced the lockstep fail-over),
+edit-distance scores 1/-1/-1, SAM input with and without qualities,
+FASTA input, and all four fragment-correction configs (kC-drop,
+kF-PAF, kF-FASTA, kF-MHAP).
 
 These run the REAL kernels, so they need TPU hardware: ci/tpu/test.sh
 runs them (the analog of the reference CI's --gtest_filter=*CUDA*
@@ -53,7 +55,7 @@ def run_device(reference_data, reads, overlaps, layout,
 def test_device_consensus_larger_window(reference_data):
     # reference CPU golden: 1289, CUDA: 4168 (racon_test.cpp:400 --
     # the config where the CUDA path loses 3x quality; ours must not).
-    # Exercises the w=1000 caps -> S=1 flagship kernel path.
+    # Exercises the w=1000 caps -> S=2 flagship kernel path.
     out, pol = run_device(reference_data, "sample_reads.fastq.gz",
                           "sample_overlaps.paf.gz",
                           "sample_layout.fasta.gz", window=1000)
@@ -133,3 +135,47 @@ def test_device_fragment_correction(reference_data):
     total = sum(len(s.data) for s in out)
     assert (len(out), total) == (236, 1658045), \
         f"device fragment correction drifted: {len(out)}/{total}"
+
+
+def test_device_fragment_correction_drop(reference_data):
+    # kC mode on ava overlaps: longest-overlap-per-query filter +
+    # drop unpolished reads (reference CPU golden: 39 / 389,394 bp at
+    # racon_test.cpp:229-235; CUDA variant :434-447).  CPU-path value:
+    # 39 / 389,344 (tests/test_e2e.py).
+    out, pol = run_device(reference_data, "sample_reads.fastq.gz",
+                          "sample_ava_overlaps.paf.gz",
+                          "sample_reads.fastq.gz",
+                          type_=PolisherType.kC, match=1, mismatch=-1,
+                          gap=-1, drop=True)
+    total = sum(len(s.data) for s in out)
+    assert (len(out), total) == (39, 389339), \
+        f"device kC fragment correction drifted: {len(out)}/{total}"
+
+
+def test_device_fragment_correction_without_qualities(reference_data):
+    # FASTA reads (uniform weights) -- reference CPU golden: 236 /
+    # 1,663,982 bp (racon_test.cpp:265-271; CUDA variant :463-478).
+    # CPU-path value: 236 / 1,663,617 (tests/test_e2e.py).
+    out, pol = run_device(reference_data, "sample_reads.fasta.gz",
+                          "sample_ava_overlaps.paf.gz",
+                          "sample_reads.fasta.gz",
+                          type_=PolisherType.kF, match=1, mismatch=-1,
+                          gap=-1, drop=False)
+    total = sum(len(s.data) for s in out)
+    assert (len(out), total) == (236, 1663658), \
+        f"device kF FASTA correction drifted: {len(out)}/{total}"
+
+
+def test_device_fragment_correction_mhap(reference_data):
+    # MHAP overlaps parse to the SAME overlap set as the PAF run, so
+    # the device output must be byte-equivalent to the kF-PAF cell
+    # above -- the reference's MHAP parity check (racon_test.cpp:
+    # 283-289, CUDA variant :479-494)
+    out, pol = run_device(reference_data, "sample_reads.fastq.gz",
+                          "sample_ava_overlaps.mhap.gz",
+                          "sample_reads.fastq.gz",
+                          type_=PolisherType.kF, match=1, mismatch=-1,
+                          gap=-1, drop=False)
+    total = sum(len(s.data) for s in out)
+    assert (len(out), total) == (236, 1658045), \
+        f"device kF MHAP parity drifted: {len(out)}/{total}"
