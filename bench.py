@@ -73,6 +73,52 @@ def accuracy(polished):
 
 _T_START = time.monotonic()
 
+# host-capability probe: the per-leg wall estimates below were
+# measured on the r6 reference host; a slower/contended host used to
+# force PERMANENTLY relaxed budgets (mega 900 s, mega_ont 500 s
+# against measured 678/145 s), which let real regressions hide inside
+# the slack on healthy hosts.  Instead the nominal estimates are
+# scaled by a measured factor: a fixed native edit-distance probe
+# (100 kb pair, 10% divergence, seeded) timed at bench start vs its
+# reference-host wall.  ADVICE r5.
+_REF_PROBE_S = 0.27
+_host_factor_cache = []
+
+
+def _host_factor() -> float:
+    if _host_factor_cache:
+        return _host_factor_cache[0]
+    factor = 1.0
+    try:
+        import numpy as np
+
+        from racon_tpu.ops import cpu
+
+        rng = np.random.default_rng(42)
+        acgt = np.frombuffer(b"ACGT", np.uint8)
+        g = acgt[rng.integers(0, 4, 100_000)]
+        m = g.copy()
+        idx = rng.random(len(m)) < 0.10
+        m[idx] = acgt[rng.integers(0, 4, int(idx.sum()))]
+        q, t = g.tobytes(), m.tobytes()
+        cpu.get_library()                 # build outside the timing
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cpu.edit_distance(q, t)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        # never tighten below the nominal estimates; cap the slack a
+        # pathological host can claim
+        factor = min(max(best / _REF_PROBE_S, 1.0), 4.0)
+        log(f"[bench] host-capability probe {best:.3f}s "
+            f"(ref {_REF_PROBE_S}s) -> budget factor {factor:.2f}")
+    except Exception as exc:
+        log(f"[bench] host probe failed ({type(exc).__name__}: "
+            f"{exc}); budget factor 1.0")
+    _host_factor_cache.append(factor)
+    return factor
+
 
 def _budget_remaining() -> float:
     try:
@@ -210,6 +256,20 @@ def main():
         cold_wall, cold_out, _ = run_polish(tpu_poa_batches=1,
                                             tpu_aligner_batches=1)
         log(f"[bench] TPU path (cold, incl. compiles): {cold_wall:.2f}s")
+        # shelf coverage diagnosis: every variant whose first contact
+        # was not a shelf hit cost the cold run a foreground
+        # trace+compile that `python -m racon_tpu.prebuild` should
+        # have absorbed (VERDICT next #4: the 13.7 s -> <8 s gap)
+        from racon_tpu.utils import aot_shelf
+        cold_misses = aot_shelf.misses()
+        if cold_misses:
+            log(f"[bench] shelf cold misses ({len(cold_misses)}):")
+            for k in cold_misses:
+                log("[bench]   miss "
+                    + "/".join(str(p) for p in k))
+        else:
+            log("[bench] shelf cold misses (0): manifest covers the "
+                "cold run")
         settle_wall, _, _ = run_polish(tpu_poa_batches=1,
                                        tpu_aligner_batches=1)
         log(f"[bench] TPU path (calibration settle): "
@@ -236,8 +296,11 @@ def main():
             f"{accel_dist} (reference CUDA golden 1385, "
             "test/racon_test.cpp:312)")
         retries = getattr(pol, "align_retry_counts", {})
+        wfa_s = getattr(pol, "align_wfa_device_s", 0.0)
+        band_s = getattr(pol, "align_band_device_s", 0.0)
         log(f"[bench] stage device_align: {align_s:.2f}s wall / "
-            f"{pol.align_device_s:.2f}s device, "
+            f"{pol.align_device_s:.2f}s device "
+            f"(wfa {wfa_s:.2f}s, band {band_s:.2f}s), "
             f"{align_cps / 1e9:.2f} Gcells/s (band cells), "
             f"rung retries {retries}")
         log(f"[bench] stage device_poa: {poa_s:.2f}s wall / "
@@ -266,9 +329,16 @@ def main():
             # thread spans): a kernel regression moves these even
             # when host jitter hides it in the stage walls
             "align_device_s": round(pol.align_device_s, 3),
+            # per-ENGINE device align time: the wavefront (WFA)
+            # kernel scales with distance, the banded kernel with
+            # band x rows -- the split shows which engine owns the
+            # align work at this workload's divergence
+            "align_wfa_device_s": round(wfa_s, 3),
+            "align_band_device_s": round(band_s, 3),
             "poa_device_s": round(pol.poa_device_s, 3),
             "align_gcells_per_s": round(align_cps / 1e9, 3),
             "poa_gcells_per_s": round(poa_cps / 1e9, 3),
+            "shelf_cold_misses": len(cold_misses),
         }
         tpu_ok = True
     except Exception as exc:  # TPU path unavailable -> report CPU path
@@ -380,21 +450,34 @@ def scale_bench():
             t0 = time.monotonic()
             pol.initialize()
             out = pol.polish(True)
-            return time.monotonic() - t0, out
+            return time.monotonic() - t0, out, pol
 
         # TPU first: if the device path fails, bail before paying for
         # the multi-minute CPU reference run.  Cold pays the scale
         # shapes' one-time compiles; warm is the steady state (same
         # methodology as the sample headline above).
-        scale_cold, _ = run(1, 1)
-        tpu_wall, tpu_out = run(1, 1)
+        scale_cold, _, _ = run(1, 1)
+        tpu_wall, tpu_out, spol = run(1, 1)
         d_tpu = cpu.edit_distance(tpu_out[0].data, truth)
-        cpu_wall, cpu_out = run(0, 0)
+        cpu_wall, cpu_out, _ = run(0, 0)
         d_cpu = cpu.edit_distance(cpu_out[0].data, truth)
         log(f"[bench] scale (300kb, 15x synthetic): CPU {cpu_wall:.1f}s"
             f" (dist {d_cpu}), TPU {tpu_wall:.1f}s warm / "
             f"{scale_cold:.1f}s cold (dist {d_tpu}), "
             f"speedup {cpu_wall / tpu_wall:.2f}x")
+        # per-stage walls for THIS leg (VERDICT weak #6: the scale
+        # leg's 2.39x vs the sample's 4.10x was unexplained because
+        # only aggregate walls shipped): device stage walls vs the
+        # leg's total expose how much is unaccelerated host stitch
+        walls = dict(spol.stage_walls)
+        other = tpu_wall - sum(walls.values())
+        log(f"[bench] scale stage walls: "
+            + ", ".join(f"{k} {v:.2f}s" for k, v in walls.items())
+            + f", host/stitch {other:.2f}s of {tpu_wall:.2f}s total"
+            f" (align device {spol.align_device_s:.2f}s = wfa "
+            f"{getattr(spol, 'align_wfa_device_s', 0.0):.2f} + band "
+            f"{getattr(spol, 'align_band_device_s', 0.0):.2f}, poa "
+            f"device {spol.poa_device_s:.2f}s)")
         return {
             "scale_tpu_cold_s": round(scale_cold, 3),
             "scale_cpu_wall_s": round(cpu_wall, 3),
@@ -457,7 +540,18 @@ def _mega_leg(prefix, label, sim_kwargs, tpu_need_s, cpu_need_s,
             f"{prefix}_poa_device_s": round(tpol.poa_device_s, 3),
             f"{prefix}_align_device_s": round(
                 tpol.align_device_s, 3),
+            # per-engine split: at ONT divergence the WFA engine
+            # should own the majority of device align work (its cost
+            # scales with distance where the band pays band x rows)
+            f"{prefix}_align_wfa_device_s": round(
+                getattr(tpol, "align_wfa_device_s", 0.0), 3),
+            f"{prefix}_align_band_device_s": round(
+                getattr(tpol, "align_band_device_s", 0.0), 3),
         }
+        log(f"[bench] {prefix} align engines: wfa "
+            f"{out[f'{prefix}_align_wfa_device_s']:.2f}s device, "
+            f"band {out[f'{prefix}_align_band_device_s']:.2f}s; "
+            f"rung retries {getattr(tpol, 'align_retry_counts', {})}")
         want_cpu = os.environ.get(f"{enable_env}_CPU", "1") == "1"
         if want_cpu and defer_cpu_for_s and \
                 _budget_remaining() < (cpu_need_s + defer_cpu_for_s):
@@ -514,14 +608,17 @@ def mega_bench():
     budget covers both) so the round's spare budget reaches the leg
     that has gone unmeasured -- r3..r5 all shipped mega_ont without a
     CPU pair because this leg always drew first."""
+    f = _host_factor()
     defer_for = 0
     if not _cpu_leg_due("mega") and _cpu_leg_due("mega_ont"):
-        defer_for = 560 + 500   # mega_ont TPU + CPU leg estimates
+        # mega_ont TPU + CPU leg estimates
+        defer_for = (560 + 170) * f
     return _mega_leg(
         "mega", "mega (4.6Mb, 30x synthetic)",
         dict(genome_len=4_600_000, coverage=30, read_len=10_000,
              seed=11),
-        380, 900, "RACON_TPU_BENCH_MEGA", defer_cpu_for_s=defer_for)
+        380 * f, 750 * f, "RACON_TPU_BENCH_MEGA",
+        defer_cpu_for_s=defer_for)
 
 
 def mega_ont_bench():
@@ -534,11 +631,12 @@ def mega_ont_bench():
     calibrated split differently from the uniform mix, so accuracy
     AND speedup go on record.  2.3 Mb / 30x (half the uniform mega)
     to fit the wall budget."""
+    f = _host_factor()
     return _mega_leg(
         "mega_ont", "mega_ont (2.3Mb, 30x ONT model)",
         dict(genome_len=2_300_000, coverage=30, read_len=10_000,
              seed=13, ont=True),
-        560, 500, "RACON_TPU_BENCH_MEGA_ONT")
+        560 * f, 170 * f, "RACON_TPU_BENCH_MEGA_ONT")
 
 
 if __name__ == "__main__":

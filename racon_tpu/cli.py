@@ -196,11 +196,21 @@ def main(argv=None):
     out = sys.stdout.buffer
     for seq in polished:
         out.write(b">" + seq.name.encode() + b"\n" + seq.data + b"\n")
+    # flush the TEXT layer before the buffer layer: anything printed
+    # via print()/sys.stdout sits in the text wrapper, and os._exit
+    # skips the interpreter teardown that would normally drain it --
+    # without this a redirected stdout could lose those bytes
+    # (ADVICE r5)
+    sys.stdout.flush()
     out.flush()
     # hard-exit once the output is flushed: background prewarm
     # compiles may still be in flight, and waiting for them (or
     # letting interpreter teardown abort them mid-C++-call) serves no
-    # one -- the binary's contract is the bytes on stdout
+    # one -- the binary's contract is the bytes on stdout.  The
+    # atexit join of the prewarm threads (tpu/polisher.py
+    # join_prewarm_threads) therefore never runs on THIS path; it
+    # exists for library/embedded callers that import racon_tpu and
+    # let the interpreter exit normally
     sys.stderr.flush()
     os._exit(0)
 

@@ -284,17 +284,20 @@ def _pow2_batch(n: int, lo: int = 8) -> int:
 def band_align_batch(queries: Sequence[bytes], targets: Sequence[bytes],
                      blq: int, blt: int, dispatch=None,
                      allow_full: bool = True,
-                     mem_budget: int = 2 << 30):
+                     mem_budget: int = 2 << 30,
+                     need_ratio: float = 0.2):
     """Align a bucket of pairs via the banded ladder.
 
     Each pair starts at the narrowest rung that could plausibly hold
-    its alignment (>= |len difference| and >= ~20% of its larger
-    dimension — ONT-scale divergence, so a guaranteed-to-fail narrow
-    pass is skipped); lanes whose tape cost is <= the half-width are
-    exact (Ukkonen) and accepted, the rest re-run wider.  Lanes still
-    unresolved past the ladder run the unbanded kernel when
-    ``allow_full``, else are returned for the caller's CPU fallback —
-    the reference's exceeded_max_alignment_difference contract
+    its alignment (>= |len difference| and >= ``need_ratio`` of its
+    larger dimension — the default 20% is ONT-scale divergence, and
+    callers that probed the dataset pass the measured ratio instead,
+    so a guaranteed-to-fail narrow pass is skipped); lanes whose tape
+    cost is <= the half-width are exact (Ukkonen) and accepted, the
+    rest re-run wider.  Lanes still unresolved past the ladder run
+    the unbanded kernel when ``allow_full``, else are returned for
+    the caller's CPU fallback — the reference's
+    exceeded_max_alignment_difference contract
     (src/cuda/cudaaligner.cpp:64-72).
 
     ``dispatch`` overrides the kernel call (used for mesh sharding);
@@ -312,9 +315,11 @@ def band_align_batch(queries: Sequence[bytes], targets: Sequence[bytes],
     ops_out = np.zeros((n, blq + blt), dtype=np.uint8)
     cells = 0
     # smallest plausible rung per lane: the band must hold the length
-    # difference, and ONT overlaps rarely align under ~20% divergence
-    need = np.maximum(np.abs(ql_all - tl_all),
-                      np.maximum(ql_all, tl_all) // 5)
+    # difference plus the divergence-scaled cost estimate
+    need = np.maximum(
+        np.abs(ql_all - tl_all),
+        (np.maximum(ql_all, tl_all)
+         * min(max(need_ratio, 0.02), 0.67)).astype(np.int64))
 
     if dispatch is None:
         def dispatch(q, t, ql, tl, lq, lt, hw):

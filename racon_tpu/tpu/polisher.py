@@ -120,9 +120,12 @@ class TPUPolisher(Polisher):
         self.align_cells = 0
         # starting-rung mispredictions per band (bench observability)
         self.align_retry_counts = {}
-        # per-run probed dataset divergence (see _probe_divergence)
+        # per-run probed dataset divergence (see _probe_divergence);
+        # the p50 default matches the scan ladder's historical 20%
+        # starting-rung guess so unprobed runs keep their exact
+        # pre-probe behavior
         self.align_probe_ratio = 1 / 3
-        self.align_probe_p50 = 1 / 4
+        self.align_probe_p50 = 1 / 5
         self.poa_cells = 0
         self.poa_reject_counts = {}
         # hybrid observability: windows consensused on device vs total
@@ -132,9 +135,15 @@ class TPUPolisher(Polisher):
         self.stage_walls = {}
         # host-independent per-dispatch device time (watcher-thread
         # spans), distinguishing kernel regressions from host jitter
-        # in bench records (VERDICT r5 #8)
+        # in bench records (VERDICT r5 #8).  The align stage splits
+        # its span per ENGINE: the wavefront (WFA) kernel whose cost
+        # scales with distance vs the banded kernel whose cost scales
+        # with band x rows -- the per-engine numbers are what the
+        # bench emits as align_wfa_device_s / align_band_device_s
         self.poa_device_s = 0.0
         self.align_device_s = 0.0
+        self.align_wfa_device_s = 0.0
+        self.align_band_device_s = 0.0
         from racon_tpu.utils.xla_cache import enable_compilation_cache
         enable_compilation_cache()
 
@@ -540,6 +549,11 @@ class TPUPolisher(Polisher):
     # (racon_tpu/utils/calibrate.py); RACON_TPU_RATE_ALIGN_* pins them
     DEV_NS_PER_ROW = 1100
     CPU_NS_PER_CELL = 4.0
+    # device WFA rate (ns per e-step per pair): modeled from the
+    # kernel's per-e-step vector body + refill DMA (~4-6 us per
+    # 8-pair program step) until the first run calibrates the
+    # "align_wfa" stage; RACON_TPU_RATE_ALIGN_WFA_{DEV,CPU} pins it
+    WFA_DEV_NS_PER_STEP = 700
     # POA defaults (us per cost unit): the device rate tracks the r6
     # kernel (S=5 interleave + 4-rank stepping, ~2.4x the r5 rate the
     # old 0.30 default described) so an UNCALIBRATED first run already
@@ -648,6 +662,24 @@ class TPUPolisher(Polisher):
         def cpu_cells(d):
             return d + (ratio * d) ** 2
 
+        # device cost model is per-ENGINE: pairs the WFA rung will
+        # take cost ~est_e e-steps (distance-scaling, like the CPU
+        # WFA) where the banded kernel costs ~rows -- without this
+        # split the rate model priced every device pair at band
+        # rates and handed the ONT-divergence align stage back to
+        # one contended host core (the 0.83x mega_ont leg)
+        wfa_cap = self._wfa_emax_cap()
+        r_wfa, _, _ = calibrate.get_rates(
+            "align_wfa", n_dev, float(self.WFA_DEV_NS_PER_STEP), 1.0)
+
+        def dev_cost(i):
+            d, o = pending[i]
+            if wfa_cap:
+                est = self._wfa_need(o, ratio)
+                if est <= wfa_cap:
+                    return est * r_wfa / n_dev
+            return d * r_dev / n_dev
+
         if not n_workers:
             cut = len(pending)
         elif "RACON_TPU_ALIGN_SPLIT" in os.environ:
@@ -656,7 +688,7 @@ class TPUPolisher(Polisher):
                 dims, float(os.environ["RACON_TPU_ALIGN_SPLIT"]))
         else:
             cut = _rate_split(
-                [d * r_dev / n_dev for d in dims],
+                [dev_cost(i) for i in range(len(pending))],
                 [r_cpu * cpu_cells(d) / n_workers for d in dims])
 
         work = deque(pending[cut:])
@@ -701,20 +733,25 @@ class TPUPolisher(Polisher):
                 "align_cpu", n_dev,
                 meas["cpu_w"] * 1e9 / meas["cpu_u"])
         if cut:
-            # drop the first dispatch per band rung and store only
-            # when later chunks exist: first dispatches pay one-time
-            # trace/compile costs, and single-chunk runs are too small
-            # for fixed dispatch latency not to swamp the signal
+            # drop the first dispatch per (engine, rung) and store
+            # only when later chunks exist: first dispatches pay
+            # one-time trace/compile costs, and single-chunk runs are
+            # too small for fixed dispatch latency not to swamp the
+            # signal.  The two engines calibrate as separate stages
+            # ("align" = banded ns/row, "align_wfa" = ns/e-step) so
+            # the split model prices each pair at the engine that
+            # will actually run it
             by_rung = {}
-            for wb_r, w, rows in self._align_disp:
-                by_rung.setdefault(wb_r, []).append((w, rows))
-            dev_w = sum(w for ch in by_rung.values()
-                        for w, _ in ch[1:])
-            dev_rows = sum(r for ch in by_rung.values()
-                           for _, r in ch[1:])
-            if dev_rows > 0 and r_src != "env":
-                calibrate.store_rates(
-                    "align", n_dev, dev_w * 1e9 * n_dev / dev_rows)
+            for eng, rung, w, units in self._align_disp:
+                by_rung.setdefault((eng, rung), []).append((w, units))
+            for eng, stage in (("band", "align"), ("wfa", "align_wfa")):
+                dev_w = sum(w for k, ch in by_rung.items()
+                            if k[0] == eng for w, _ in ch[1:])
+                dev_u = sum(u for k, ch in by_rung.items()
+                            if k[0] == eng for _, u in ch[1:])
+                if dev_u > 0 and r_src != "env":
+                    calibrate.store_rates(
+                        stage, n_dev, dev_w * 1e9 * n_dev / dev_u)
         if n_cpu_done:
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::align] cpu-aligned "
@@ -801,13 +838,50 @@ class TPUPolisher(Polisher):
                 f"[racon_tpu::TPUPolisher::align] cpu-aligned "
                 f"{n_cpu_done} overlaps concurrently")
 
+    def _wfa_emax_cap(self) -> int:
+        """Max e-step the device WFA rung may use (0 disables it);
+        RACON_TPU_WFA_EMAX caps it, RACON_TPU_WFA=0 turns the rung
+        off entirely."""
+        from racon_tpu.tpu import align_pallas
+        if not align_pallas.wfa_available():
+            return 0
+        return max(0, _env_int("RACON_TPU_WFA_EMAX", 2048))
+
+    @staticmethod
+    def _wfa_need(o: Overlap, ratio: float) -> int:
+        """Estimated edit distance of one overlap at probed
+        divergence ``ratio`` -- the WFA rung admission estimate (a
+        pair whose true distance exceeds the rung wastes a full
+        forward pass, so admission uses the p75 ratio, conservative
+        where the banded starting rung uses the median)."""
+        lq = o.q_end - o.q_begin
+        lt = o.t_end - o.t_begin
+        return abs(lq - lt) + int(max(lq, lt) * ratio)
+
+    _WFA_RUNGS = (512, 1024, 2048)
+
     def _pallas_align(self, overlaps: List[Overlap]) -> None:
-        """Single-dispatch device alignment (align_pallas kernel): all
-        pairs in ONE shape bucket (dynamic row loops make padding
-        free), with a two-rung band escalation; pairs the widest band
-        cannot certify are left to the CPU fall-through (the
-        reference's exceeded_max_alignment_difference contract,
-        src/cuda/cudaaligner.cpp:64-72)."""
+        """Device alignment ladder (align_pallas kernels), cheapest
+        engine first:
+
+        1. **WFA rung** -- the wavefront kernel, whose cost scales
+           with edit DISTANCE: pairs whose estimated distance fits an
+           e-step rung run there first; a finishing pair's distance
+           is exact (no band certificate needed) and its tape decodes
+           to the native CPU engine's CIGAR byte-for-byte.
+        2. **Re-centered banded rungs** -- pairs the WFA rejects
+           (distance or indel drift past the rung) fall to the banded
+           kernel; RETRY pairs follow a measured diagonal path
+           (estimate_center_knots) instead of the proportional line,
+           accepted when the recovered path keeps >= 2 quanta of
+           band margin (path_center_margin) -- large indel drift no
+           longer escalates the rung ladder to the widest bands.
+        3. Pairs the widest band cannot resolve take the CPU
+           fall-through (the reference's
+           exceeded_max_alignment_difference contract,
+           src/cuda/cudaaligner.cpp:64-72)."""
+        import time as _time
+
         from racon_tpu.tpu import align_pallas, aligner
 
         queries = [o.query_span(self.sequences) for o in overlaps]
@@ -815,77 +889,167 @@ class TPUPolisher(Polisher):
         dim = max(max(len(s) for s in queries),
                   max(len(s) for s in targets))
         bd = min((dim + 127) // 128 * 128, self.max_align_dim)
-        # per-pair starting rung from the expected cost (length
-        # difference, divergence-scaled dimension), like the scan
-        # ladder -- running a guaranteed-to-fail narrow band doubles
-        # the work, while starting too wide wastes band columns.
-        # Ukkonen certificate for the proportional-diagonal band: a
-        # path of cost c deviates at most (c + |dlen|) / 2 columns
-        # from the diagonal, so a band of wb columns (quantized 128,
-        # margin wb/2 - 256 per side) certifies
-        # cost + |dlen| <= wb - 512.
-        # The starting rung uses the probe's MEDIAN divergence: a rung
-        # retry costs (1 + retry_fraction) of the band where starting
-        # a rung higher costs 2x for everyone, so the median pair
-        # should start at the rung that just certifies it (the p75
-        # the CPU cost model uses pushed every sample pair up a rung
-        # when the distribution sat at a certify boundary).  The
-        # retry counters below keep mispredictions visible.
         ratio = min(max(self.align_probe_p50, 0.05), 0.67)
+        ratio75 = min(max(self.align_probe_ratio, 0.05), 0.67)
         dabs = [abs(len(q) - len(t))
                 for q, t in zip(queries, targets)]
-        need = [max(dabs[i], int(max(len(q), len(t)) * ratio))
-                for i, (q, t) in enumerate(zip(queries, targets))]
+        # banded-rung cost estimate (median divergence; see the
+        # starting-rung rationale in the git history: the median pair
+        # should start at the rung that just certifies it) and the
+        # re-centered admission estimate (cost only -- the measured
+        # center absorbs the length-difference drift)
+        needc = [int(max(len(q), len(t)) * ratio)
+                 for q, t in zip(queries, targets)]
+        need = [max(dabs[i], needc[i]) for i in range(len(overlaps))]
+        # WFA admission (p75 divergence: a pair past the rung wastes
+        # a full forward pass, so over-admitting is the costly error)
+        wfa_need = [dabs[i] + int(max(len(queries[i]),
+                                      len(targets[i])) * ratio75)
+                    for i in range(len(overlaps))]
         pending = list(range(len(overlaps)))
+        n_dev = len(self.mesh.devices)
+
+        wfa_cap = self._wfa_emax_cap()
+        wfa_rungs = [e for e in self._WFA_RUNGS if e <= wfa_cap]
+        wfa_groups = {}
+        if wfa_rungs:
+            for i in pending:
+                for e in wfa_rungs:
+                    if wfa_need[i] <= e - 32:
+                        wfa_groups.setdefault(e, []).append(i)
+                        break
+            # sub-16-pair rungs ride the next rung up (a tiny batch
+            # pays a whole dispatch + often a fresh variant)
+            for e in wfa_rungs[:-1]:
+                if 0 < len(wfa_groups.get(e, ())) < 16:
+                    nxt = wfa_rungs[wfa_rungs.index(e) + 1]
+                    wfa_groups.setdefault(nxt, [])[:0] = \
+                        wfa_groups.pop(e)
         rungs = (2048, 4096, 8192)
-        self._prewarm_align_rungs(rungs, need, dabs, bd)
+        # the first rung to run (WFA when any group exists, else the
+        # first band) traces in the foreground; everything later
+        # prewarns in the background while it owns the device
+        later = [("wfa", e) for e in sorted(wfa_groups)[1:]] \
+            + [("band", wb)
+               for wb in (rungs if wfa_groups else rungs[1:])]
+        self._prewarm_align_rungs(later, wfa_groups, need, dabs, bd)
+
+        # RACON_TPU_WFA=0 pins the whole pre-r7 ladder (no WFA rung,
+        # no measured-center retries) -- the TPU CI golden configs
+        # rely on this to keep their committed bytes valid
+        recenter = align_pallas.wfa_available()
+        use_emp: set = set()       # pairs on measured-center retry
+        knots: dict = {}
+
+        def emp_knots(i):
+            if i not in knots:
+                knots[i] = align_pallas.estimate_center_knots(
+                    queries[i], targets[i], bd)
+            return knots[i]
+
+        # ---- 1. WFA rungs: distance-scaling device path ----------
+        for emax in sorted(wfa_groups):
+            idx = [i for i in wfa_groups[emax] if i in set(pending)]
+            if not idx:
+                continue
+            max_b = max(8 * n_dev,
+                        int(self.align_mem_budget
+                            // align_pallas.wfa_per_pair_bytes(
+                                bd, emax)))
+            max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
+            if len(idx) > max_b:
+                max_b = min(max_b, max(8 * n_dev, max_b // 2))
+            chunks = [idx[c0:c0 + max_b]
+                      for c0 in range(0, len(idx), max_b)]
+
+            def dispatch(sub, emax=emax):
+                return align_pallas.wfa_dispatch(
+                    [queries[i] for i in sub],
+                    [targets[i] for i in sub], bd, emax,
+                    mesh=self.mesh)
+
+            n_cert = 0
+            still = set()
+            pending_c = dispatch(chunks[0])
+            t_mark = _time.monotonic()
+            for ci, sub in enumerate(chunks):
+                nxt = dispatch(chunks[ci + 1]) \
+                    if ci + 1 < len(chunks) else None
+                tapes, nents, dists = pending_c()
+                dev_s = getattr(pending_c, "device_s",
+                                lambda: 0.0)()
+                self.align_device_s += dev_s
+                self.align_wfa_device_s += dev_s
+                pending_c = nxt
+                steps = float(sum(min(int(d), emax) for d in dists))
+                if hasattr(self, "_align_disp"):
+                    now = _time.monotonic()
+                    self._align_disp.append(
+                        ("wfa", emax, now - t_mark, steps))
+                    t_mark = now
+                # e-steps actually run x diagonal extent = the honest
+                # cell count for a wavefront engine
+                self.align_cells += int(steps) * (2 * emax + 1)
+                for k, i in enumerate(sub):
+                    if int(dists[k]) <= emax:
+                        ops = align_pallas.wfa_tape_to_ops(
+                            tapes[k], int(nents[k]))
+                        overlaps[i].cigar_runs = \
+                            aligner.ops_to_runs(ops)
+                        n_cert += 1
+                    else:
+                        still.add(i)
+            idx_set = set(idx)
+            pending = [i for i in pending
+                       if i in still or i not in idx_set]
+            # WFA rejects carry measured centers into the band rungs
+            use_emp.update(still)
+            if still:
+                self.align_retry_counts[f"wfa{emax}"] = \
+                    self.align_retry_counts.get(f"wfa{emax}", 0) \
+                    + len(still)
+            self.logger.log(
+                f"[racon_tpu::TPUPolisher::align] wfa-aligned "
+                f"{n_cert}/{len(idx)} overlaps (emax {emax}"
+                + (f", {len(still)} to band" if still else "") + ")")
+
+        # ---- 2. banded rungs (re-centered for retries) -----------
         for wb in rungs:
             if not pending:
                 break
-            # the forced last rung still skips pairs that provably
-            # cannot certify (distance >= dabs)
+            # admission: the Ukkonen certificate bound for
+            # proportional pairs; cost-only for measured-center pairs
+            # (the knots absorb the drift); the forced last rung
+            # still skips pairs that provably cannot certify
             idx = [i for i in pending
                    if need[i] + dabs[i] <= wb - 512
+                   or (i in use_emp and needc[i] <= wb - 512)
                    or (wb == rungs[-1] and 2 * dabs[i] <= wb - 512)]
             if not idx:
                 continue
             if len(idx) < 16 and wb != rungs[-1]:
-                # a sub-16-pair batch pays a whole dispatch (and often
-                # a fresh compiled variant) for almost no work; let
-                # the stragglers ride the next rung's batch instead
                 continue
             # chunk the dispatch so one batch's device footprint
-            # (checkpoint HBM region + q/t/tape) stays in budget
-            max_b = max(8 * len(self.mesh.devices),
+            # (checkpoint HBM region + q/t/tape) stays in budget;
+            # two-deep pipeline => each chunk fits HALF the budget
+            max_b = max(8 * n_dev,
                         int(self.align_mem_budget
                             // align_pallas.per_pair_bytes(bd, wb)))
             max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
             n_cert = 0
             still = set()
-            import time as _time
-
-            # two-deep pipeline: dispatch chunk k+1 before collecting
-            # chunk k, so the host-side decode of one chunk (and the
-            # tunnel's collect round trip) hides under the next
-            # chunk's device compute.  Two chunks are in flight, so
-            # each must fit HALF the memory budget for the documented
-            # footprint bound to keep holding -- and the per-device
-            # floor must never push the halved chunk back ABOVE the
-            # budget-derived cap (ADVICE r5: on memory-constrained
-            # multi-device configs the unclamped floor let two
-            # in-flight chunks exceed the documented bound)
             if len(idx) > max_b:
-                max_b = min(max_b,
-                            max(8 * len(self.mesh.devices),
-                                max_b // 2))
+                max_b = min(max_b, max(8 * n_dev, max_b // 2))
             chunks = [idx[c0:c0 + max_b]
                       for c0 in range(0, len(idx), max_b)]
 
-            def dispatch(sub):
+            def dispatch(sub, wb=wb):
                 return align_pallas.align_dispatch(
                     [queries[i] for i in sub],
                     [targets[i] for i in sub],
-                    bd, bd, wb, mesh=self.mesh)
+                    bd, bd, wb, mesh=self.mesh,
+                    centers=[emp_knots(i) if i in use_emp else None
+                             for i in sub])
 
             pending_c = dispatch(chunks[0])
             t_mark = _time.monotonic()
@@ -893,19 +1057,28 @@ class TPUPolisher(Polisher):
                 nxt = dispatch(chunks[ci + 1]) \
                     if ci + 1 < len(chunks) else None
                 moves, lens, dists = pending_c()
-                self.align_device_s += getattr(
-                    pending_c, "device_s", lambda: 0.0)()
+                dev_s = getattr(pending_c, "device_s",
+                                lambda: 0.0)()
+                self.align_device_s += dev_s
+                self.align_band_device_s += dev_s
                 pending_c = nxt
                 if hasattr(self, "_align_disp"):
                     now = _time.monotonic()
                     self._align_disp.append(
-                        (wb, now - t_mark,
+                        ("band", wb, now - t_mark,
                          float(sum(len(queries[i]) for i in sub))))
                     t_mark = now
                 self.align_cells += sum(len(queries[i])
                                         for i in sub) * wb
                 for k, i in enumerate(sub):
-                    if dists[k] + dabs[i] <= wb - 512:
+                    if i in use_emp:
+                        ok = int(dists[k]) < align_pallas._BIG and \
+                            align_pallas.path_center_margin(
+                                moves[k], int(lens[k]), knots[i],
+                                wb) >= 256
+                    else:
+                        ok = dists[k] + dabs[i] <= wb - 512
+                    if ok:
                         ops = align_pallas.moves_to_ops(
                             moves[k], int(lens[k]), queries[i],
                             targets[i])
@@ -917,13 +1090,15 @@ class TPUPolisher(Polisher):
             idx_set = set(idx)
             pending = [i for i in pending
                        if i in still or i not in idx_set]
+            # a rung failure switches the pair to measured centers
+            # for its retry -- the escalation-cutting move
+            if recenter:
+                use_emp.update(still)
             # mispredicted starting rungs double-pay the kernel; the
-            # counter keeps that visible (bench prints it)
+            # counter keeps that visible (bench prints it).  Only
+            # failures with a WIDER rung left are retries;
+            # final-rung failures are permanent CPU fall-throughs
             if wb != rungs[-1]:
-                # only failures with a WIDER rung left are retries (a
-                # misprediction double-pays the kernel); final-rung
-                # failures are permanent CPU fall-throughs and would
-                # otherwise masquerade as predictor error
                 self.align_retry_counts[wb] = \
                     self.align_retry_counts.get(wb, 0) + len(still)
             tag = (f", {len(still)} "
@@ -935,12 +1110,14 @@ class TPUPolisher(Polisher):
         # survivors lack a CIGAR and take the CPU fall-through
         # (the reference's exceeded_max_alignment_difference skip)
 
-    def _prewarm_align_rungs(self, rungs, need, dabs, bd) -> None:
-        """Trace+compile the LATER band rungs' kernel variants on a
-        daemon thread while the first rung owns the device (the rung
-        sets are re-derived exactly as the dispatch loop will, minus
-        retries — a retry-shifted batch shape just costs one more
-        foreground trace, same as before)."""
+    def _prewarm_align_rungs(self, later, wfa_groups, need, dabs,
+                             bd) -> None:
+        """Trace+compile the LATER rungs' kernel variants (WFA rungs
+        past the first, every banded rung) on a daemon thread while
+        the first rung owns the device (the rung sets are re-derived
+        exactly as the dispatch loop will, minus retries — a
+        retry-shifted batch shape just costs one more foreground
+        trace, same as before)."""
         import jax
 
         from racon_tpu.tpu import align_pallas
@@ -949,39 +1126,51 @@ class TPUPolisher(Polisher):
                 return
         except Exception:
             return
-        import threading
 
         n_dev = len(self.mesh.devices)
+        in_wfa = {i for idxs in wfa_groups.values() for i in idxs}
         shapes = []
-        pend = list(range(len(need)))
-        first = True
-        for wb in rungs:
-            idx = [i for i in pend
-                   if need[i] + dabs[i] <= wb - 512
-                   or (wb == rungs[-1] and 2 * dabs[i] <= wb - 512)]
-            if not idx:
-                continue
-            if not first:
+        band_rungs = [r for eng, r in later if eng == "band"]
+        for eng, rung in later:
+            if eng == "wfa":
+                idx = wfa_groups.get(rung, ())
+                if not idx:
+                    continue
                 max_b = max(8 * n_dev,
                             int(self.align_mem_budget
-                                // align_pallas.per_pair_bytes(bd,
-                                                               wb)))
+                                // align_pallas.wfa_per_pair_bytes(
+                                    bd, rung)))
                 max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
-                n_pad = align_pallas.pad_pairs(min(len(idx), max_b),
-                                               n_dev)
-                shapes.append((n_pad, wb))
-            first = False
-            hit = set(idx)
-            pend = [i for i in pend if i not in hit]
+                shapes.append(("wfa", align_pallas.pad_pairs(
+                    min(len(idx), max_b), n_dev), rung))
+                continue
+            idx = [i for i in range(len(need)) if i not in in_wfa
+                   and (need[i] + dabs[i] <= rung - 512
+                        or (rung == band_rungs[-1]
+                            and 2 * dabs[i] <= rung - 512))]
+            if not idx:
+                continue
+            in_wfa.update(idx)      # taken: later rungs see the rest
+            max_b = max(8 * n_dev,
+                        int(self.align_mem_budget
+                            // align_pallas.per_pair_bytes(bd, rung)))
+            max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
+            shapes.append(("band", align_pallas.pad_pairs(
+                min(len(idx), max_b), n_dev), rung))
 
         if not shapes:
             return
         mesh = self.mesh
 
         def work():
-            for n_pad, wb in shapes:
+            for eng, n_pad, rung in shapes:
                 try:
-                    align_pallas.prewarm(n_pad, bd, bd, wb, mesh=mesh)
+                    if eng == "wfa":
+                        align_pallas.wfa_prewarm(n_pad, bd, rung,
+                                                 mesh=mesh)
+                    else:
+                        align_pallas.prewarm(n_pad, bd, bd, rung,
+                                             mesh=mesh)
                 except Exception:
                     return
 
@@ -1014,10 +1203,14 @@ class TPUPolisher(Polisher):
 
         # overlaps the ladder cannot resolve go to the CPU aligner
         # (reference: exceeded_max_alignment_difference skip,
-        # src/cuda/cudaaligner.cpp:64-72 + cudapolisher.cpp:212-216)
+        # src/cuda/cudaaligner.cpp:64-72 + cudapolisher.cpp:212-216).
+        # The probed per-run divergence replaces the hardcoded 20%
+        # starting-rung guess (a 5%-divergence dataset used to pay a
+        # rung it never needed)
         ops, cells, unresolved = aligner.band_align_batch(
             queries, targets, blq, blt, dispatch=dispatch,
-            allow_full=False, mem_budget=self.align_mem_budget)
+            allow_full=False, mem_budget=self.align_mem_budget,
+            need_ratio=self.align_probe_p50)
         self.align_cells += cells
         skip = set(unresolved.tolist())
         for idx, o in enumerate(chunk):

@@ -24,7 +24,36 @@ import threading
 _mem: dict = {}
 _salts: dict = {}
 _recorded: set = set()
+# first-contact outcome per variant key_parts: "hit" (deserialized
+# from the shelf), "miss" (had to export+compile), "fallback" (export
+# unsupported or artifact failed -> plain traced path).  Anything but
+# "hit" on a cold run is start-up latency the prebuild manifest should
+# have covered -- bench.py prints this list after its cold leg so the
+# residual cold-start gap stays diagnosable (VERDICT next #4).
+_contact: dict = {}
 _lock = threading.Lock()
+
+
+def contacts() -> dict:
+    with _lock:
+        return dict(_contact)
+
+
+def misses() -> list:
+    """Variant keys whose first contact this process was NOT a shelf
+    hit (each cost a foreground trace+compile)."""
+    with _lock:
+        return [k for k, v in _contact.items() if v != "hit"]
+
+
+def _log_contact(key_parts: tuple, outcome: str) -> None:
+    with _lock:
+        if key_parts in _contact:
+            return
+        _contact[key_parts] = outcome
+    import sys
+    print(f"[racon_tpu::aot_shelf] {outcome}: "
+          f"{'/'.join(str(p) for p in key_parts)}", file=sys.stderr)
 
 # bump when kernel-relevant code OUTSIDE the keyed source file changes
 # behavior (the key hashes only the caller's own source file; helpers
@@ -142,6 +171,7 @@ def call(key_parts: tuple, src_file: str, build_fn, args: tuple):
         try:
             with open(path, "rb") as f:
                 exp = jexport.deserialize(f.read())
+            _log_contact(key_parts, "hit")
         except Exception:
             try:
                 os.remove(path)
@@ -151,6 +181,7 @@ def call(key_parts: tuple, src_file: str, build_fn, args: tuple):
     if exp is None:
         try:
             exp = jexport.export(jax.jit(build_fn))(*args)
+            _log_contact(key_parts, "miss")
             blob = exp.serialize()
             os.makedirs(_shelf_dir(), exist_ok=True)
             tmp = path + f".tmp{os.getpid()}"
@@ -160,6 +191,7 @@ def call(key_parts: tuple, src_file: str, build_fn, args: tuple):
         except Exception:
             # export unsupported for this function/config: remember the
             # plain path for this process and move on
+            _log_contact(key_parts, "fallback")
             with _lock:
                 _mem[key] = build_fn
             return build_fn(*args)
@@ -178,6 +210,7 @@ def call(key_parts: tuple, src_file: str, build_fn, args: tuple):
             pass
         with _lock:
             _mem[key] = build_fn
+            _contact[key_parts] = "fallback"   # stale artifact retraced
         return build_fn(*args)
     with _lock:
         _mem[key] = fn

@@ -74,7 +74,10 @@ def _machine_key(n_dev: int) -> str:
 
 def get_rates(stage: str, n_dev: int, default_dev: float,
               default_cpu: float) -> tuple:
-    """(dev_rate, cpu_rate, source) for a hybrid stage.  Precedence:
+    """(dev_rate, cpu_rate, source) for a hybrid stage.  Stages in
+    use: "poa" (us/cost-unit), "align" (banded device ns/row),
+    "align_wfa" (wavefront device ns/e-step), "align_cpu" (host WFA
+    ns/modeled-cell).  Precedence:
     env pin > persisted calibration > defaults.  Reads the persisted
     file on every call (it is tiny), so a multi-polish process adopts its own
     measurements as they land; within one polish each stage reads its

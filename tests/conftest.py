@@ -22,6 +22,8 @@ os.environ.setdefault("RACON_TPU_RATE_POA_DEV", "0.30")
 os.environ.setdefault("RACON_TPU_RATE_POA_CPU", "2.0")
 os.environ.setdefault("RACON_TPU_RATE_ALIGN_DEV", "1100")
 os.environ.setdefault("RACON_TPU_RATE_ALIGN_CPU", "4.0")
+os.environ.setdefault("RACON_TPU_RATE_ALIGN_WFA_DEV", "700")
+os.environ.setdefault("RACON_TPU_RATE_ALIGN_WFA_CPU", "1.0")
 
 if os.environ.get("RACON_TPU_TEST_PLATFORM", "cpu") == "cpu":
     os.environ["JAX_PLATFORMS"] = "cpu"

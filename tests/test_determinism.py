@@ -7,6 +7,14 @@ must emit byte-identical FASTA — XLA kernels are deterministic and the
 host-side stitching is order-stable, so any divergence is a real
 nondeterminism bug (thread-ordering leak, unstable sort, uninitialised
 pad lanes).
+
+The DOCUMENTED invariance set (README "Determinism") is stronger than
+a double-run cmp: output bytes are a function of (input, thread
+count, device count, split rates) ONLY — machine state that is
+allowed to vary between runs (the persisted calibration cache, the
+AOT shelf, cold vs warm compile state) must not reach the bytes.
+``test_invariance_set`` pins exactly that: same threads + devices +
+pinned rates across DIFFERENT cache roots ⇒ identical FASTA.
 """
 
 import os
@@ -19,6 +27,37 @@ from racon_tpu.core.polisher import PolisherType, create_polisher
 def fasta_bytes(polished):
     return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
                     for s in polished)
+
+
+def test_invariance_set(tmp_path, monkeypatch):
+    """Same thread count + device count + pinned rates ⇒ identical
+    bytes, regardless of per-machine cache state: each run gets a
+    FRESH cache root (empty XLA cache, empty AOT shelf, no persisted
+    calibration), so any byte that depended on cache warmth or a
+    previously stored rate would diff here."""
+    import tempfile
+
+    from racon_tpu.tools import simulate
+
+    with tempfile.TemporaryDirectory(prefix="racon_inv_") as tmp:
+        reads, paf, draft = simulate.simulate(
+            tmp, genome_len=20_000, coverage=8, read_len=1_000,
+            seed=21, ont=True)
+
+        outs = []
+        for run in range(2):
+            monkeypatch.setenv("RACON_TPU_CACHE_DIR",
+                               str(tmp_path / f"cache{run}"))
+            pol = create_polisher(
+                reads, paf, draft, PolisherType.kC, 500, 10.0, 0.3,
+                True, 5, -4, -8, num_threads=8, tpu_poa_batches=1,
+                tpu_aligner_batches=1)
+            pol.initialize()
+            outs.append(fasta_bytes(pol.polish(True)))
+        assert outs[0] == outs[1], (
+            "documented invariance set violated: bytes depended on "
+            "cache/calibration state, not (input, threads, devices, "
+            "rates)")
 
 
 @pytest.mark.slow

@@ -22,6 +22,12 @@ comments for parity review.
 
 import os
 
+# pinned-golden config: the matrix values predate the device WFA
+# rung; its native-parity CIGARs shift co-optimal breaking points, so
+# the golden config keeps the rung off (see ci/tpu/goldens.py) until
+# the pinned values are intentionally regenerated
+os.environ.setdefault("RACON_TPU_WFA", "0")
+
 import jax
 import pytest
 

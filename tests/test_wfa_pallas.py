@@ -1,0 +1,161 @@
+"""Device WFA kernel parity (racon_tpu/tpu/align_pallas.py).
+
+The wavefront kernel must report EXACT edit distances and decode to
+CIGARs byte-identical to the native CPU WFA engine
+(racon_tpu/native/align.cpp) -- the in-kernel traceback replicates
+its candidate and preference rules -- across the divergence levels
+the align ladder routes to it (5/15/25%), with pyref.py as the
+independent oracle for cost/consumption.  Interpret mode on the CPU
+test platform; the same assertions run compiled on real TPU hardware
+(ci/tpu/test.sh).
+"""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+import jax
+
+from racon_tpu.ops import cpu, pyref
+from racon_tpu.tpu import aligner as al
+from tests.test_tpu_aligner import mutate, random_seq
+
+
+@pytest.fixture()
+def ap_interp(monkeypatch):
+    from jax.experimental import pallas as pl
+
+    from racon_tpu.tpu import align_pallas as ap
+
+    if jax.devices()[0].platform != "tpu":
+        orig = pl.pallas_call
+
+        def interp(*a, **kw):
+            kw["interpret"] = True
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(ap.pl, "pallas_call", interp)
+    return ap
+
+
+def merged_m_cigar(cig: str) -> str:
+    """Fold =/X runs into 'M' runs (the native engine's alphabet)."""
+    ops = "".join(("M" if o in "=X" else o) * int(n)
+                  for n, o in re.findall(r"(\d+)([=XID])", cig))
+    out, k = "", 0
+    while k < len(ops):
+        r = 1
+        while k + r < len(ops) and ops[k + r] == ops[k]:
+            r += 1
+        out += f"{r}{ops[k]}"
+        k += r
+    return out
+
+
+def check_pair(ap, q, t, tape, nent, dist):
+    want = cpu.edit_distance(q, t)
+    assert int(dist) == want, "WFA distance is not exact"
+    ops = ap.wfa_tape_to_ops(tape, int(nent))
+    cig = al.ops_to_cigar(ops)
+    qn, tn = pyref.cigar_consumes(cig)
+    assert (qn, tn) == (len(q), len(t)), "tape does not consume pair"
+    assert pyref.cigar_distance(cig, q, t) == want, \
+        "tape cost disagrees with the pyref oracle"
+    ncig, ndist = cpu.align_with_distance(q, t)
+    assert ndist == want
+    assert merged_m_cigar(cig) == ncig, \
+        "device WFA CIGAR diverged from the native engine"
+
+
+@pytest.mark.parametrize("rate", [0.05, 0.15, 0.25])
+def test_wfa_divergence_parity(ap_interp, rate):
+    ap = ap_interp
+    rng = random.Random(int(rate * 100))
+    qs, ts = [], []
+    for n in (300, 420):
+        q = random_seq(n, rng)
+        qs.append(q)
+        ts.append(mutate(q, rate, rng))
+    tapes, nents, dists = ap.wfa_batch(qs, ts, 512, 192)
+    for i in range(len(qs)):
+        check_pair(ap, qs[i], ts[i], tapes[i], int(nents[i]),
+                   int(dists[i]))
+
+
+def test_wfa_structural_indel_and_reject(ap_interp):
+    ap = ap_interp
+    rng = random.Random(7)
+    # 60bp deletion: the diagonal drifts but stays inside emax
+    q = random_seq(400, rng)
+    t = mutate(q[:150] + q[210:], 0.03, rng)
+    tapes, nents, dists = ap.wfa_batch([q], [t], 512, 128)
+    check_pair(ap, q, t, tapes[0], int(nents[0]), int(dists[0]))
+    # distance beyond emax must reject with _BIG (ladder escalates)
+    q2 = random_seq(300, rng)
+    t2 = mutate(q2, 0.5, rng)
+    _, _, d2 = ap.wfa_batch([q2], [t2], 512, 64)
+    assert int(d2[0]) == ap._BIG
+    # empty pair: invalid, rejected, no tape
+    _, n3, d3 = ap.wfa_batch([b""], [b"ACGT"], 512, 64)
+    assert int(d3[0]) == ap._BIG and int(n3[0]) == 0
+
+
+def test_wfa_mixed_batch_lockstep(ap_interp):
+    """Pairs of different lengths/divergences share one stacked
+    program; per-pair freeze must keep each result independent."""
+    ap = ap_interp
+    rng = random.Random(13)
+    qs, ts = [], []
+    for n, r in ((120, 0.02), (300, 0.2), (64, 0.0), (250, 0.1)):
+        q = random_seq(n, rng)
+        qs.append(q)
+        ts.append(mutate(q, r, rng))
+    tapes, nents, dists = ap.wfa_batch(qs, ts, 384, 96)
+    for i in range(len(qs)):
+        check_pair(ap, qs[i], ts[i], tapes[i], int(nents[i]),
+                   int(dists[i]))
+
+
+def test_center_knots_track_indel_drift(ap_interp):
+    """The strided pre-pass must place the band on the measured
+    diagonal path: a pair with a large mid-sequence deletion
+    certifies (margin criterion) in a band the proportional center
+    cannot certify at (Ukkonen bound)."""
+    ap = ap_interp
+    rng = random.Random(5)
+    q = random_seq(1800, rng)
+    t = mutate(q[:600] + q[1000:], 0.04, rng)
+    want = cpu.edit_distance(q, t)
+    dabs = abs(len(q) - len(t))
+    kn = ap.estimate_center_knots(q, t, 2048)
+    assert np.all(np.diff(kn) >= 0), "knots must be monotone"
+    moves, lens, dists = ap.align_batch([q], [t], 2048, 2048, 1024,
+                                        centers=[kn])
+    assert int(dists[0]) == want
+    margin = ap.path_center_margin(moves[0], int(lens[0]), kn, 1024)
+    assert margin >= 256, "measured center left the path near the edge"
+    # the proportional Ukkonen certificate provably cannot accept at
+    # this width -- the escalation the re-centering removes
+    assert want + dabs > 1024 - 512
+    ops = ap.moves_to_ops(moves[0], int(lens[0]), q, t)
+    cost = int(np.sum((ops != al.OP_STOP) & (ops != al.OP_EQ)))
+    assert cost == want
+
+
+def test_proportional_knots_default():
+    from racon_tpu.tpu import align_pallas as ap
+
+    kn = ap.proportional_knots(1000, 2000, 4096)
+    assert kn[0] == 0 and kn.dtype == np.int32
+    assert np.all(np.diff(kn) >= 0)
+    # the interpolated center must hit tl at row ql (knots keep the
+    # slope past ql instead of flattening at tl)
+    k = 1000 >> ap._CTR_LOG
+    c = kn[k] + ((int(kn[k + 1]) - int(kn[k]))
+                 * (1000 - (k << ap._CTR_LOG)) >> ap._CTR_LOG)
+    assert abs(c - 2000) <= 4
+    # per-row center advance stays inside the kernel's realignment
+    # window (2 quanta = 256 columns/row)
+    assert np.max(np.diff(kn)) <= 255 * ap._CTR_BLK
