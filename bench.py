@@ -298,6 +298,17 @@ def main():
         retries = getattr(pol, "align_retry_counts", {})
         wfa_s = getattr(pol, "align_wfa_device_s", 0.0)
         band_s = getattr(pol, "align_band_device_s", 0.0)
+        overlap_s = getattr(pol, "pipeline_overlap_s", 0.0)
+        from racon_tpu.utils import calibrate
+        pred = calibrate.predict_walls(align_s, poa_s, overlap_s)
+        log(f"[bench] pipeline overlap: {overlap_s:.2f}s of the POA "
+            f"span ran inside the align stage "
+            f"(efficiency {pred.get('overlap_efficiency', 0.0):.0%}; "
+            f"additive model {pred['additive_wall_s']:.2f}s, "
+            f"overlapped floor {pred['overlapped_floor_s']:.2f}s, "
+            f"spec windows used/wasted "
+            f"{getattr(pol, 'poa_spec_used', 0)}/"
+            f"{getattr(pol, 'poa_spec_wasted', 0)})")
         log(f"[bench] stage device_align: {align_s:.2f}s wall / "
             f"{pol.align_device_s:.2f}s device "
             f"(wfa {wfa_s:.2f}s, band {band_s:.2f}s), "
@@ -339,6 +350,14 @@ def main():
             "align_gcells_per_s": round(align_cps / 1e9, 3),
             "poa_gcells_per_s": round(poa_cps / 1e9, 3),
             "shelf_cold_misses": len(cold_misses),
+            # streaming pipeline: how much of the POA span ran inside
+            # the align stage (wall ~ align + poa - overlap), plus the
+            # speculative-scheduling adoption counters and the split
+            # decision inputs (ISSUE r8: explain capped device share)
+            "pipeline_overlap_s": round(overlap_s, 3),
+            "poa_spec_used": int(getattr(pol, "poa_spec_used", 0)),
+            "poa_spec_wasted": int(getattr(pol, "poa_spec_wasted", 0)),
+            "poa_split_detail": getattr(pol, "poa_split_detail", {}),
         }
         tpu_ok = True
     except Exception as exc:  # TPU path unavailable -> report CPU path
@@ -388,14 +407,16 @@ def main():
             log(f"[bench] scale bench skipped "
                 f"({type(exc).__name__}: {exc})")
 
+        mega_out = {}
         try:
-            extra.update(mega_bench())
+            mega_out = mega_bench()
+            extra.update(mega_out)
         except Exception as exc:
             log(f"[bench] mega bench skipped "
                 f"({type(exc).__name__}: {exc})")
 
         try:
-            extra.update(mega_ont_bench())
+            extra.update(mega_ont_bench(mega_out))
         except Exception as exc:
             log(f"[bench] mega_ont bench skipped "
                 f"({type(exc).__name__}: {exc})")
@@ -489,7 +510,7 @@ def scale_bench():
 
 
 def _mega_leg(prefix, label, sim_kwargs, tpu_need_s, cpu_need_s,
-              enable_env, defer_cpu_for_s=0):
+              enable_env, defer_cpu_for_s=0, seed_rate=None):
     """Shared megabase leg runner (uniform + ONT models): simulate,
     run the TPU hybrid, optionally the CPU reference, record
     accuracy, rejects, device share and per-stage device time under
@@ -499,7 +520,12 @@ def _mega_leg(prefix, label, sim_kwargs, tpu_need_s, cpu_need_s,
     the budget covers both.  A skipped-or-deferred CPU leg still
     ships ``{prefix}_cpu_wall_s`` whenever any prior round measured
     it, tagged ``{prefix}_cpu_wall_provenance: carried_forward:<rec>``
-    so the record is complete AND honest."""
+    so the record is complete AND honest.  When no prior measurement
+    exists either, ``seed_rate=(src_label, src_wall_s, src_units)``
+    estimates the wall from another leg's measured CPU rate scaled by
+    genome x coverage units, tagged ``seeded_from_rate:<src>`` — so a
+    speedup is ALWAYS reported (r5 shipped mega_ont with no CPU pair
+    at all because the carry-forward had nothing to carry)."""
     import jax
     on_tpu = jax.devices()[0].platform == "tpu"
     if os.environ.get(enable_env, "1" if on_tpu else "0") != "1":
@@ -547,6 +573,12 @@ def _mega_leg(prefix, label, sim_kwargs, tpu_need_s, cpu_need_s,
                 getattr(tpol, "align_wfa_device_s", 0.0), 3),
             f"{prefix}_align_band_device_s": round(
                 getattr(tpol, "align_band_device_s", 0.0), 3),
+            f"{prefix}_pipeline_overlap_s": round(
+                getattr(tpol, "pipeline_overlap_s", 0.0), 3),
+            f"{prefix}_poa_spec_used": int(
+                getattr(tpol, "poa_spec_used", 0)),
+            f"{prefix}_poa_split_detail": getattr(
+                tpol, "poa_split_detail", {}),
         }
         log(f"[bench] {prefix} align engines: wfa "
             f"{out[f'{prefix}_align_wfa_device_s']:.2f}s device, "
@@ -588,10 +620,27 @@ def _mega_leg(prefix, label, sim_kwargs, tpu_need_s, cpu_need_s,
             log(f"[bench] {label}: TPU {tpu_wall:.1f}s (dist "
                 f"{d_tpu}), {rejects} POA rejects; CPU wall "
                 f"{wall:.1f}s carried forward from {src}")
-        else:
-            log(f"[bench] {label}: TPU {tpu_wall:.1f}s (dist {d_tpu}),"
-                f" {rejects} POA rejects (CPU leg skipped, no prior "
-                "measurement to carry)")
+            return out
+        if seed_rate is not None:
+            # no prior measurement to carry: seed from another leg's
+            # measured CPU rate (wall per genome x coverage unit) with
+            # its own provenance tag, so the speedup is reported while
+            # staying distinguishable from measured AND carried values
+            src_label, src_wall, src_units = seed_rate
+            units = sim_kwargs["genome_len"] * sim_kwargs["coverage"]
+            est = src_wall * units / max(src_units, 1)
+            out[f"{prefix}_cpu_wall_s"] = round(est, 3)
+            out[f"{prefix}_speedup"] = round(est / tpu_wall, 3)
+            out[f"{prefix}_cpu_wall_provenance"] = \
+                f"seeded_from_rate:{src_label}"
+            log(f"[bench] {label}: TPU {tpu_wall:.1f}s (dist "
+                f"{d_tpu}), {rejects} POA rejects; CPU wall "
+                f"~{est:.1f}s seeded from {src_label}'s measured "
+                "rate (no prior measurement to carry)")
+            return out
+        log(f"[bench] {label}: TPU {tpu_wall:.1f}s (dist {d_tpu}),"
+            f" {rejects} POA rejects (CPU leg skipped, no prior "
+            "measurement to carry)")
         return out
 
 
@@ -621,7 +670,7 @@ def mega_bench():
         defer_cpu_for_s=defer_for)
 
 
-def mega_ont_bench():
+def mega_ont_bench(mega_out=None):
     """Megabase leg on the ONT-realistic error model
     (tools/simulate.py --ont: homopolymer-enriched genome,
     homopolymer-biased indels, lognormal read lengths,
@@ -630,13 +679,29 @@ def mega_ont_bench():
     here).  Real ONT error structure stresses the POA band and the
     calibrated split differently from the uniform mix, so accuracy
     AND speedup go on record.  2.3 Mb / 30x (half the uniform mega)
-    to fit the wall budget."""
+    to fit the wall budget.
+
+    When neither this round nor any committed round measured this
+    leg's CPU wall, the mega leg's measured CPU rate seeds an
+    estimate (distinct ``seeded_from_rate`` provenance) so
+    mega_ont_speedup is always reported."""
     f = _host_factor()
+    seed = None
+    mega_units = 4_600_000 * 30
+    if mega_out and mega_out.get("mega_cpu_wall_s") is not None \
+            and "mega_cpu_wall_provenance" not in mega_out:
+        seed = ("mega(this round)", float(mega_out["mega_cpu_wall_s"]),
+                mega_units)
+    else:
+        src, wall, _ = _carried_cpu_leg("mega")
+        if wall is not None:
+            seed = (f"mega({src})", wall, mega_units)
     return _mega_leg(
         "mega_ont", "mega_ont (2.3Mb, 30x ONT model)",
         dict(genome_len=2_300_000, coverage=30, read_len=10_000,
              seed=13, ont=True),
-        560 * f, 170 * f, "RACON_TPU_BENCH_MEGA_ONT")
+        560 * f, 170 * f, "RACON_TPU_BENCH_MEGA_ONT",
+        seed_rate=seed)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Tier-1 goldens under the streaming pipeline, with a deadlock
+# watchdog (ISSUE r8 CI satellite):
+#   * RACON_TPU_PIPELINE=1 pins the cross-stage producer/consumer
+#     seam ON (it is the default, but the pin keeps this lane
+#     meaningful if the default ever changes);
+#   * PYTHONDEVMODE=1 surfaces unawaited futures, unjoined threads
+#     and other asyncio/threading hygiene slips in the new seam;
+#   * pytest's faulthandler timeout dumps EVERY thread's traceback
+#     if a single test exceeds the budget, so a deadlocked
+#     producer/consumer queue shows up as a stack dump naming the
+#     blocked lock instead of an opaque CI timeout.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export RACON_TPU_PIPELINE=1
+export PYTHONDEVMODE=1
+python -m pytest tests/ -q -m "not slow" \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"

@@ -177,6 +177,18 @@ def main(argv=None):
         print(USAGE, end="", file=sys.stderr)
         raise SystemExit(1)
 
+    if opts["tpu_poa_batches"] > 0 or opts["tpu_aligner_batches"] > 0:
+        # kick off the AOT-shelf prewarm NOW, before the (multi-second)
+        # input parse below: the jax import and the shelved kernel
+        # loads run behind the parse instead of after it
+        # (racon_tpu/tpu/polisher.py spawn_cli_prewarm)
+        try:
+            from racon_tpu.tpu.polisher import spawn_cli_prewarm
+            spawn_cli_prewarm(opts["match"], opts["mismatch"],
+                              opts["gap"], opts["trim"])
+        except ImportError:
+            pass   # TPU support missing: create_polisher reports it
+
     try:
         polisher = create_polisher(
             inputs[0], inputs[1], inputs[2], opts["type"],

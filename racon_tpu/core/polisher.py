@@ -89,6 +89,12 @@ class Polisher:
         self.windows: List[Window] = []
         self.targets_coverages: List[int] = []
         self._owned_targets = None   # multi-host target mask
+        # streaming bookkeeping (racon_tpu/tpu/polisher.py pipeline):
+        # window-id offsets per target, and whether the subclass
+        # already counted per-target coverages at registration time
+        self._first_window_id: List[int] = []
+        self._targets_size = 0
+        self._coverage_counted = False
         self.dummy_quality = b"!" * window_length
         self.engine = cpu.PoaEngine(match, mismatch, gap)
         self.logger = Logger()
@@ -191,8 +197,10 @@ class Polisher:
                        if total_sequences_length / sequences_size <= 1000
                        else WindowType.TGS)
         # recorded for subclasses that predict device-kernel variants
-        # before windows exist (racon_tpu/tpu/polisher.py prewarm)
+        # or create windows before the align stage finishes
+        # (racon_tpu/tpu/polisher.py prewarm + streaming pipeline)
         self.window_type = window_type
+        self._targets_size = targets_size
 
         self.logger.log("[racon_tpu::Polisher::initialize] loaded sequences")
         self.logger.log()
@@ -302,6 +310,7 @@ class Polisher:
         def work(o: Overlap) -> None:
             o.find_breaking_points(self.sequences, self.window_length,
                                    aligner=cpu.align)
+            self._notify_overlap_done(o)
 
         self._run_pooled([(work, (o,)) for o in overlaps],
                          "[racon_tpu::Polisher::initialize] aligning "
@@ -325,12 +334,26 @@ class Polisher:
             self.logger.log(done_message)
         return results
 
+    def _notify_overlap_done(self, o: Overlap) -> None:
+        """Per-overlap completion hook: fired (possibly from a pool
+        thread) once ``o.breaking_points`` exists.  The base pipeline
+        does nothing; the TPU polisher's streaming pipeline overrides
+        this to advance its per-target/per-window completion ledger
+        and route the overlap's window fragments as the align stage
+        drains (racon_tpu/tpu/polisher.py)."""
+
     # ------------------------------------------------------------------
     # windowing (reference: src/polisher.cpp:383-456)
     # ------------------------------------------------------------------
 
-    def _build_windows(self, targets_size: int, window_type: WindowType,
-                       overlaps: List[Overlap]) -> None:
+    def _create_windows(self, targets_size: int,
+                        window_type: WindowType) -> None:
+        """Backbone window skeleton per owned target.  Idempotent: the
+        streaming pipeline creates the windows BEFORE the align stage
+        (so completed targets can enter POA while later ones are still
+        aligning) and the staged path creates them here."""
+        if self.windows:
+            return
         id_to_first_window_id = [0] * (targets_size + 1)
         for i in range(targets_size):
             if self._owned_targets is not None \
@@ -350,42 +373,59 @@ class Polisher:
                                            data[j:j + length], q))
                 k += 1
             id_to_first_window_id[i + 1] = id_to_first_window_id[i] + k
-
+        self._first_window_id = id_to_first_window_id
         self.targets_coverages = [0] * targets_size
 
+    def _overlap_window_fragments(self, o: Overlap):
+        """Yield ``(window_id, data, quality, begin, end)`` for every
+        breaking-point pair of ``o`` that passes the length/quality
+        filters — the routing rule of the staged ``_build_windows``,
+        factored out so the streaming seam can route per overlap as
+        alignments complete.  Caller clears ``o.breaking_points``."""
+        points = o.breaking_points
+        if points is None:
+            return
         w = self.window_length
-        for o in overlaps:
-            self.targets_coverages[o.t_id] += 1
-            sequence = self.sequences[o.q_id]
-            points = o.breaking_points
-            if points is None:
+        sequence = self.sequences[o.q_id]
+        # check the stored slot: reverse_quality exists iff transmute
+        # materialised it; the property would create it as a side
+        # effect (reference getter has none, src/sequence.hpp)
+        has_quality = bool(sequence.quality) or \
+            bool(sequence._reverse_quality)
+        quality_src = (sequence.reverse_quality if o.strand
+                       else sequence.quality)
+        data_src = (sequence.reverse_complement if o.strand
+                    else sequence.data)
+        for j in range(0, len(points), 2):
+            t_first, q_first = int(points[j][0]), int(points[j][1])
+            t_last, q_last = int(points[j + 1][0]), int(points[j + 1][1])
+            if q_last - q_first < 0.02 * w:
                 continue
-            # check the stored slot: reverse_quality exists iff transmute
-            # materialised it; the property would create it as a side
-            # effect (reference getter has none, src/sequence.hpp)
-            has_quality = bool(sequence.quality) or \
-                bool(sequence._reverse_quality)
-            quality_src = (sequence.reverse_quality if o.strand
-                           else sequence.quality)
-            data_src = (sequence.reverse_complement if o.strand
-                        else sequence.data)
-            for j in range(0, len(points), 2):
-                t_first, q_first = int(points[j][0]), int(points[j][1])
-                t_last, q_last = int(points[j + 1][0]), int(points[j + 1][1])
-                if q_last - q_first < 0.02 * w:
+            if has_quality and quality_src:
+                frag_q = quality_src[q_first:q_last]
+                average_quality = (sum(frag_q) / len(frag_q)) - 33
+                if average_quality < self.quality_threshold:
                     continue
-                if has_quality and quality_src:
-                    frag_q = quality_src[q_first:q_last]
-                    average_quality = (sum(frag_q) / len(frag_q)) - 33
-                    if average_quality < self.quality_threshold:
-                        continue
-                window_id = id_to_first_window_id[o.t_id] + t_first // w
-                window_start = (t_first // w) * w
-                data = data_src[q_first:q_last]
-                quality = quality_src[q_first:q_last] if quality_src else None
-                self.windows[window_id].add_layer(
-                    data, quality, t_first - window_start,
-                    t_last - window_start - 1)
+            window_id = self._first_window_id[o.t_id] + t_first // w
+            window_start = (t_first // w) * w
+            data = data_src[q_first:q_last]
+            quality = quality_src[q_first:q_last] if quality_src else None
+            yield (window_id, data, quality, t_first - window_start,
+                   t_last - window_start - 1)
+
+    def _build_windows(self, targets_size: int, window_type: WindowType,
+                       overlaps: List[Overlap]) -> None:
+        self._create_windows(targets_size, window_type)
+        for o in overlaps:
+            if not self._coverage_counted:
+                self.targets_coverages[o.t_id] += 1
+            if o.breaking_points is None:
+                # already routed by the streaming seam (or carried no
+                # points at all)
+                continue
+            for wid, data, quality, begin, end in \
+                    self._overlap_window_fragments(o):
+                self.windows[wid].add_layer(data, quality, begin, end)
             o.breaking_points = None
 
     # ------------------------------------------------------------------

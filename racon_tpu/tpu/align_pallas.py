@@ -554,6 +554,42 @@ def per_pair_bytes(bd: int, wb: int) -> int:
     return (bd // _ckrows(wb) + 1) * wb * 4 + 6 * bd
 
 
+def pipeline_depth() -> int:
+    """In-flight chunks per device dispatch loop (RACON_TPU_PIPE_DEPTH,
+    clamped to [1, 4]).  Depth 2 is the classic double buffer: chunk
+    k+1 is packed on the host and enqueued while k executes and k-1's
+    tapes decode; deeper keeps more chunks in flight at proportionally
+    smaller per-chunk memory budgets (callers divide their HBM chunk
+    cap by this depth)."""
+    try:
+        d = int(os.environ.get("RACON_TPU_PIPE_DEPTH", "2"))
+    except ValueError:
+        d = 2
+    return max(1, min(d, 4))
+
+
+def run_pipelined(chunks, dispatch, consume, depth: int = None) -> None:
+    """Drive ``dispatch(chunk) -> collect`` over ``chunks`` keeping up
+    to ``depth`` dispatches in flight, consuming strictly in FIFO
+    order (``consume(chunk, collect)``).  JAX dispatch is async, so
+    the host packs and enqueues chunk k+1 while the device still
+    executes chunk k -- the shared loop body of the WFA rung, the
+    banded rung and the POA megabatch dispatchers."""
+    if depth is None:
+        depth = pipeline_depth()
+    from collections import deque
+
+    inflight = deque()
+    for sub in chunks:
+        inflight.append((sub, dispatch(sub)))
+        if len(inflight) >= max(1, depth):
+            sub0, coll = inflight.popleft()
+            consume(sub0, coll)
+    while inflight:
+        sub0, coll = inflight.popleft()
+        consume(sub0, coll)
+
+
 def pad_pairs(n: int, n_dev: int = 1) -> int:
     """Batch padding rule: power of two (floor 32), a multiple of the
     stacking factor and of the mesh size.  The floor keeps the

@@ -591,7 +591,8 @@ class TPUPoaBatchEngine:
                 full = 1 if (begin < offset
                              and end > len(bb) - offset) else 0
                 meta[b, d, :4] = (begin, end, full, len(s))
-        self.phase_walls["export"] += time.monotonic() - t0
+        with self._reject_lock:
+            self.phase_walls["export"] += time.monotonic() - t0
 
         t_disp = time.monotonic()
         handle = poa_pallas.poa_full_dispatch(
@@ -605,15 +606,20 @@ class TPUPoaBatchEngine:
             t0 = time.monotonic()
             cons, mout = handle()
             blocked = time.monotonic() - t0
-            # NOTE under the two-deep pipeline: "dispatch" counts only
-            # the UN-overlapped blocking residual (device time hidden
-            # behind the next batch's packing shows up in no bucket),
-            # so phase walls no longer sum to the stage wall; the
-            # watcher-thread span below is the host-independent
-            # per-dispatch device time
-            self.phase_walls["dispatch"] += blocked
-            self.device_s += getattr(handle, "device_s",
-                                     lambda: 0.0)()
+            # NOTE under the double-buffered pipeline: "dispatch"
+            # counts only the UN-overlapped blocking residual (device
+            # time hidden behind the next batch's packing shows up in
+            # no bucket), so phase walls no longer sum to the stage
+            # wall; the watcher-thread span below is the
+            # host-independent per-dispatch device time.  Counter
+            # updates take the lock: the streaming pipeline
+            # (racon_tpu/tpu/polisher.py) shares one engine between
+            # the speculative align-stage consumer thread and the
+            # stage-time dispatch loop
+            with self._reject_lock:
+                self.phase_walls["dispatch"] += blocked
+                self.device_s += getattr(handle, "device_s",
+                                         lambda: 0.0)()
             if os.environ.get("RACON_TPU_POA_TRACE"):
                 import sys
                 live = nlay[:n][nlay[:n] > 0]
@@ -623,8 +629,9 @@ class TPUPoaBatchEngine:
                       f"span {time.monotonic() - t_disp:.2f}s "
                       f"blocked {blocked:.2f}s",
                       file=sys.stderr, flush=True)
-            self.n_rounds += 1
-            self.cells += int(mout[:n, 4].sum()) * wb
+            with self._reject_lock:
+                self.n_rounds += 1
+                self.cells += int(mout[:n, 4].sum()) * wb
 
             t1 = time.monotonic()
             results: List[Tuple[Optional[bytes], bool]] = []
@@ -646,7 +653,8 @@ class TPUPoaBatchEngine:
                     w.warn_chimeric()
                 results.append(
                     (bytes(cons[b, :length].astype(np.uint8)), True))
-            self.phase_walls["extract"] += time.monotonic() - t1
+            with self._reject_lock:
+                self.phase_walls["extract"] += time.monotonic() - t1
             return results
 
         return collect

@@ -19,6 +19,7 @@ plays the role of CUDA streams.
 from __future__ import annotations
 
 import os
+import threading
 from typing import List
 
 import numpy as np
@@ -54,6 +55,40 @@ def join_prewarm_threads(timeout: float = None) -> None:
 import atexit as _atexit
 
 _atexit.register(join_prewarm_threads)
+
+
+def spawn_cli_prewarm(match: int, mismatch: int, gap: int,
+                      trim: bool) -> None:
+    """Start AOT-shelf prewarm at CLI entry, BEFORE input parsing:
+    the jax import (~seconds) and the shelved kernel-variant loads
+    (~0.1 s each) run on a background thread while the main thread
+    parses FASTA/PAF, instead of serializing after parsing inside the
+    first dispatch (r5 cold_wall 13.7 s vs 3.5 s warm — parsing time
+    was never hidden behind compile/deserialize time).  Best-effort:
+    any failure leaves the normal first-contact path intact.
+    RACON_TPU_CLI_PREWARM=0 disables."""
+    if os.environ.get("RACON_TPU_CLI_PREWARM", "1") == "0":
+        return
+
+    def work():
+        try:
+            from racon_tpu.utils import aot_shelf
+            from racon_tpu.utils.xla_cache import \
+                enable_compilation_cache
+            if not aot_shelf.enabled():
+                return   # CPU/interpret backends trace cheaply
+            enable_compilation_cache()
+            from racon_tpu import prebuild
+            for entry in prebuild.config_entries(match, mismatch,
+                                                 gap, trim):
+                try:
+                    prebuild._build_one(entry)
+                except Exception:
+                    pass
+        except Exception:
+            pass
+
+    _spawn_prewarm(work, "racon-cli-prewarm")
 
 
 def _env_int(name: str, default: int) -> int:
@@ -144,6 +179,31 @@ class TPUPolisher(Polisher):
         self.align_device_s = 0.0
         self.align_wfa_device_s = 0.0
         self.align_band_device_s = 0.0
+        # streaming pipeline state (RACON_TPU_PIPELINE, default on):
+        # cross-stage target/window streaming + speculative device POA
+        # during the align stage.  Engine ASSIGNMENT stays the
+        # deterministic rate-model argmin computed at stage time over
+        # the full window set -- speculative results are only USED for
+        # windows that argmin assigns to the device, so output bytes
+        # are identical to the staged path and timing only changes
+        # WHEN work runs, never who runs it.
+        self._pipeline_mode = False
+        self._ledger = None
+        self._poa_engine = None
+        self._spec_results = {}
+        self._spec_cap = 0
+        self._consumer = None
+        self._consumer_stop = False
+        self._decode_futs = []
+        self._stream_errors = []
+        self._stream_lock = threading.Lock()
+        self._align_device_free = threading.Event()
+        self._poa_first_dispatch_t = None
+        self._align_end_t = None
+        self.pipeline_overlap_s = 0.0
+        self.poa_spec_used = 0
+        self.poa_spec_wasted = 0
+        self.poa_split_detail = {}
         from racon_tpu.utils.xla_cache import enable_compilation_cache
         enable_compilation_cache()
 
@@ -211,6 +271,237 @@ class TPUPolisher(Polisher):
             return 0
         return max(0, self.num_threads - 1)
 
+    # ------------------------------------------------------------------
+    # streaming pipeline (cross-stage target/window streaming)
+    # ------------------------------------------------------------------
+
+    def _pipeline_enabled(self) -> bool:
+        """Cross-stage streaming gate: on by default whenever the POA
+        stage is device-offloaded (RACON_TPU_PIPELINE=0 restores the
+        strictly staged align-then-POA ordering).  Output bytes are
+        identical either way -- see _device_generate_consensuses."""
+        return (os.environ.get("RACON_TPU_PIPELINE", "1") != "0"
+                and self.tpu_poa_batches > 0)
+
+    def _make_poa_engine(self):
+        from racon_tpu.tpu.poa import TPUPoaBatchEngine
+
+        vcap, lcap = self._poa_caps()
+        return TPUPoaBatchEngine(
+            self.match, self.mismatch, self.gap, vcap=vcap, pcap=16,
+            lcap=lcap, kcap=128, max_depth=self.MAX_DEPTH_PER_WINDOW,
+            banded=self.tpu_banded_alignment, mesh=self.mesh)
+
+    def _pipeline_begin(self, overlaps: List[Overlap]) -> None:
+        """Set up the producer/consumer seam before the align stage:
+        create the window skeleton, register every overlap's window
+        range with the completion ledger (per-target accounting at
+        window granularity -- a single-contig polish still streams),
+        and start the speculative POA consumer."""
+        from racon_tpu.core.window import WindowLedger
+
+        self._create_windows(self._targets_size, self.window_type)
+        self._ledger = WindowLedger(len(self.windows))
+        w = self.window_length
+        for idx, o in enumerate(overlaps):
+            # coverage is counted here, over the full deterministic
+            # overlap list, so the residual _build_windows pass must
+            # not double count (core/polisher.py _coverage_counted)
+            self.targets_coverages[o.t_id] += 1
+            lo = self._first_window_id[o.t_id] + o.t_begin // w
+            hi = self._first_window_id[o.t_id] \
+                + max(o.t_end - 1, o.t_begin) // w
+            self._ledger.register(id(o), idx, lo, hi)
+        self._coverage_counted = True
+        self._ledger.seal()
+        self._spec_results = {}
+        self._decode_futs = []
+        self._consumer_stop = False
+        self._poa_first_dispatch_t = None
+        self._poa_engine = self._make_poa_engine()
+        vcap, lcap = self._poa_caps()
+        n_dev = len(self.mesh.devices)
+        self._spec_cap = min(
+            self._poa_batch_size(vcap, lcap, n_dev),
+            n_dev * _env_int("RACON_TPU_POA_MEGABATCH", 256))
+        self._consumer = threading.Thread(
+            target=self._poa_consumer_loop, daemon=True,
+            name="racon-poa-stream")
+        self._consumer.start()
+
+    def _notify_overlap_done(self, o: Overlap) -> None:
+        led = self._ledger
+        if led is None or not self._pipeline_mode:
+            return
+        try:
+            if o.breaking_points is not None:
+                frags = [(self._ledger_ordinal(o), wid, data, qual, b, e)
+                         for wid, data, qual, b, e
+                         in self._overlap_window_fragments(o)]
+                o.breaking_points = None
+            else:
+                frags = []
+            newly = led.complete(id(o), frags)
+        except Exception as exc:   # never lose a routing bug silently
+            with self._stream_lock:
+                self._stream_errors.append(exc)
+            return
+        if not newly:
+            return
+        ready = []
+        for wid, wfrags in newly:
+            win = self.windows[wid]
+            for _, _, data, qual, begin, end in wfrags:
+                win.add_layer(data, qual, begin, end)
+            # only device-eligible windows feed the consumer; trivial
+            # (<3 sequences) windows keep the backbone at stage time
+            if len(win.sequences) >= 3:
+                ready.append(wid)
+        led.push_ready(ready)
+
+    def _ledger_ordinal(self, o: Overlap) -> int:
+        with self._ledger.cond:
+            reg = self._ledger._reg.get(id(o))
+        return reg[0] if reg else 0
+
+    def _finish_overlap(self, o: Overlap) -> None:
+        """Pool task: decode one device-aligned overlap's breaking
+        points while the device computes the next chunk, then advance
+        the completion ledger."""
+        try:
+            o.find_breaking_points(self.sequences, self.window_length)
+            self._notify_overlap_done(o)
+        except Exception as exc:
+            with self._stream_lock:
+                self._stream_errors.append(exc)
+
+    def _stream_decode(self, o: Overlap) -> None:
+        """Queue breaking-point decode + ledger notify for an overlap
+        whose CIGAR just arrived from the device (no-op when the
+        pipeline is off: the staged fall-through pass handles it).
+        The queued futures are drained before the fall-through pass so
+        exactly one thread ever computes a given overlap's points."""
+        if self._pipeline_mode:
+            self._decode_futs.append(
+                self._pool.submit(self._finish_overlap, o))
+
+    def _drain_stream_decodes(self) -> None:
+        for f in self._decode_futs:
+            f.result()   # _finish_overlap never raises; this is a join
+        self._decode_futs = []
+
+    def _mark_align_device_free(self) -> None:
+        """The align stage's last device dispatch is enqueued: from
+        here speculative POA megabatches queue behind it and fill the
+        device time the align stage's CPU tail used to leave idle
+        (dispatching earlier would push the align chunks back -- the
+        device queue is FIFO)."""
+        self._align_device_free.set()
+
+    def _note_poa_dispatch(self) -> None:
+        import time as _time
+        if self._poa_first_dispatch_t is None:
+            self._poa_first_dispatch_t = _time.monotonic()
+
+    def _poa_consumer_loop(self) -> None:
+        """Speculative POA consumer: while the align stage drains,
+        dispatch megabatches of ready windows through the SAME engine
+        the stage will use.  Results land in _spec_results keyed by
+        window id; the stage later uses them only for windows the
+        deterministic rate-model argmin assigns to the device (the
+        rest are recomputed by the CPU engine exactly as in the staged
+        path), so speculation never reaches the output bytes."""
+        from racon_tpu.tpu import align_pallas as _ap
+
+        led = self._ledger
+        eng = self._poa_engine
+        min_take = max(1, _env_int("RACON_TPU_PIPE_MIN", 32))
+        depth = _ap.pipeline_depth()
+        inflight = []
+
+        def collect_one():
+            idxs, coll = inflight.pop(0)
+            try:
+                for i, r in zip(idxs, coll()):
+                    self._spec_results[i] = r
+            except Exception as exc:
+                with self._stream_lock:
+                    self._stream_errors.append(exc)
+
+        while True:
+            stop = self._consumer_stop
+            take = []
+            if not stop and self._align_device_free.is_set():
+                # leftovers below min_take stay queued for the stage
+                # (tiny speculative batches mint fresh kernel-variant
+                # shapes for no overlap gain); at stop nothing new is
+                # taken -- there is no align time left to hide it in
+                take = led.pop_ready(self._spec_cap, min_take)
+            if take:
+                # deepest-first: megabatch rounds drain uniformly and
+                # the deepest windows are the likeliest device
+                # assignees under the argmin (least speculation waste)
+                take.sort(
+                    key=lambda i: -len(self.windows[i].sequences))
+                batch = [self.windows[i] for i in take]
+                self._note_poa_dispatch()
+                try:
+                    coll = eng.consensus_batch_async(batch, self.trim,
+                                                     pool=self._pool)
+                    inflight.append((take, coll))
+                except Exception as exc:
+                    with self._stream_lock:
+                        self._stream_errors.append(exc)
+                while len(inflight) >= depth:
+                    collect_one()
+                continue
+            if stop:
+                while inflight:
+                    collect_one()
+                return
+            with led.cond:
+                led.cond.wait(0.02)
+
+    def _pipeline_align_done(self) -> None:
+        """End of the align stage: complete any overlap the streaming
+        hooks missed (stash drains sort by overlap ordinal, so layer
+        order stays canonical regardless of completion order), stop
+        the consumer, and surface any error a pool-side decode
+        swallowed."""
+        import time as _time
+
+        self._align_end_t = _time.monotonic()
+        self._mark_align_device_free()
+        led = self._ledger
+        if led is not None and led.remaining():
+            # every overlap was notified by the fall-through pass, so
+            # leftover registrations mean a completion hook errored --
+            # fail loudly rather than emit a consensus with silently
+            # missing layers
+            with self._stream_lock:
+                self._stream_errors.append(RuntimeError(
+                    f"streaming seam left {len(led.remaining())} "
+                    "overlap(s) unrouted"))
+        self._consumer_stop = True
+        if led is not None:
+            with led.cond:
+                led.cond.notify_all()
+        with self._stream_lock:
+            return list(self._stream_errors)
+
+    def _join_consumer(self) -> None:
+        if self._consumer is not None:
+            self._consumer_stop = True
+            if self._ledger is not None:
+                with self._ledger.cond:
+                    self._ledger.cond.notify_all()
+            self._consumer.join()
+            self._consumer = None
+
+    # ------------------------------------------------------------------
+    # POA consensus stage entry
+    # ------------------------------------------------------------------
+
     def generate_consensuses(self) -> List[bool]:
         if self.tpu_poa_batches <= 0:
             return super().generate_consensuses()
@@ -219,12 +510,22 @@ class TPUPolisher(Polisher):
         t0 = time.monotonic()
         with TraceAnnotation("racon_tpu.device_poa"):
             flags = self._device_generate_consensuses()
-        self.stage_walls["device_poa"] = time.monotonic() - t0
+        end = time.monotonic()
+        start = t0
+        if self._poa_first_dispatch_t is not None:
+            # the POA stage's span starts at its FIRST dispatch --
+            # under the pipeline that is during the align stage, and
+            # the overlap of the two spans is the wall the streaming
+            # seam removed (bench: pipeline_overlap_s; wall ~
+            # align + poa - overlap instead of align + poa)
+            start = min(start, self._poa_first_dispatch_t)
+            if self._align_end_t is not None:
+                self.pipeline_overlap_s = max(
+                    0.0, self._align_end_t - self._poa_first_dispatch_t)
+        self.stage_walls["device_poa"] = end - start
         return flags
 
     def _device_generate_consensuses(self) -> List[bool]:
-        from racon_tpu.tpu.poa import TPUPoaBatchEngine
-
         vcap, lcap = self._poa_caps()
         n_dev = len(self.mesh.devices)
         batch_size = self._poa_batch_size(vcap, lcap, n_dev)
@@ -234,12 +535,16 @@ class TPUPolisher(Polisher):
                          n_dev * _env_int("RACON_TPU_POA_MEGABATCH",
                                           256))
         # -b narrows the POA band (cudapoa banded analog); default is
-        # the auto band (l_b/4, floor 256)
-        engine = TPUPoaBatchEngine(
-            self.match, self.mismatch, self.gap, vcap=vcap, pcap=16,
-            lcap=lcap, kcap=128, max_depth=self.MAX_DEPTH_PER_WINDOW,
-            banded=self.tpu_banded_alignment,
-            mesh=self.mesh)
+        # the auto band (l_b/4, floor 256).  Under the pipeline the
+        # engine already exists (the speculative consumer used it
+        # during the align stage) and is reused so its counters span
+        # both phases.
+        engine = self._poa_engine or self._make_poa_engine()
+        self._poa_engine = None
+        # speculative results from the align-stage consumer (empty
+        # when the pipeline is off or nothing became ready in time)
+        self._join_consumer()
+        spec = self._spec_results
 
         # trivial windows (<3 sequences) keep the backbone and count as
         # unpolished (window.cpp:68-71); the rest go to the device in
@@ -318,6 +623,75 @@ class TPUPolisher(Polisher):
                 f"{dev_left}/{len(eligible)} windows "
                 f"({r_src} rates {r_dev:.2f}/{r_cpu:.2f})")
 
+        # split observability (bench: poa_split_detail): the decision
+        # inputs that produced this cut, so a capped device share is
+        # attributable to the calibrated rates vs the depth/length
+        # distribution without rerunning (ISSUE r8: the 0.71 share
+        # with 0 rejects was unexplainable from the shipped record)
+        sd_dev, sd_cpu, sd_src = calibrate.get_rates(
+            "poa", n_dev, self.POA_DEV_US_PER_UNIT,
+            self.POA_CPU_US_PER_UNIT)
+        units = [unit_of[i] for i in eligible]
+        depths = [len(self.windows[i].sequences) - 1 for i in eligible]
+        total_u = sum(units) or 1.0
+
+        def _q(v, q):
+            return v[min(len(v) - 1, int(q * len(v)))] if v else 0
+
+        self.poa_split_detail = {
+            "mode": ("steal" if steal else
+                     "device_only" if not n_workers else
+                     "env_split" if "RACON_TPU_POA_SPLIT" in os.environ
+                     else "rate_model"),
+            "rate_dev_us_per_unit": round(sd_dev, 4),
+            "rate_cpu_us_per_unit": round(sd_cpu, 4),
+            "rate_source": sd_src,
+            "n_dev": n_dev, "n_cpu_workers": n_workers,
+            "cut": int(dev_left), "n_eligible": len(eligible),
+            "dev_unit_share": round(sum(units[:dev_left]) / total_u, 4),
+            "unit_total": round(total_u, 1),
+            "depth_p50": _q(sorted(depths), 0.5),
+            "depth_p90": _q(sorted(depths), 0.9),
+            "depth_max": max(depths, default=0),
+            "unit_p50": round(_q(sorted(units), 0.5), 2),
+            "unit_p90": round(_q(sorted(units), 0.9), 2),
+        }
+
+        # apply speculative consensuses: ONLY for windows this stage's
+        # deterministic argmin assigns to the device (assignment never
+        # follows speculation, so bytes match the staged path); spec
+        # results for CPU-assigned windows are discarded and those
+        # windows recomputed by the CPU engine below.  Under
+        # RACON_TPU_STEAL (documented as run-to-run varying) every
+        # spec result is used.
+        spec_failed: List[int] = []
+        if spec:
+            assigned = eligible if steal else eligible[:dev_left]
+            resolved = [i for i in assigned if i in spec]
+            for i in resolved:
+                cons, ok = spec[i]
+                if cons is None:
+                    # device reject: CPU re-polish below, exactly as a
+                    # stage-time dispatch of this window would have
+                    spec_failed.append(i)
+                else:
+                    self.windows[i].consensus = cons
+                    flags[i] = ok
+                    self.poa_device_windows += 1
+            self.poa_spec_used = len(resolved)
+            self.poa_spec_wasted = len(spec) - len(resolved)
+            if resolved:
+                rset = set(resolved)
+                work = deque(i for i in eligible if i not in rset)
+                dev_left -= len(resolved)
+            if steal or not n_workers:
+                dev_left = len(work)
+            self.logger.log(
+                f"[racon_tpu::TPUPolisher::polish] poa stream: "
+                f"{self.poa_spec_used}/{len(spec)} speculative "
+                f"window(s) adopted "
+                f"({self.poa_spec_wasted} recomputed on CPU)")
+
         def cpu_worker():
             while True:
                 with lock:
@@ -334,14 +708,18 @@ class TPUPolisher(Polisher):
         workers = [self._pool.submit(cpu_worker)
                    for _ in range(n_workers)]
 
-        failed: List[int] = []
-        # two-deep pipeline: dispatch megabatch k+1 (upload + kernel
-        # enqueue are async) BEFORE collecting k, so host packing and
-        # the tunnel's upload latency overlap device compute -- the
-        # async analog of the reference's threaded per-device batch
-        # queues (src/cuda/cudapolisher.cpp:257-336).  Results apply
-        # in FIFO order, so output stays deterministic.
-        pending = None          # (idxs, collect_fn)
+        failed: List[int] = list(spec_failed)
+        # double-buffered pipeline: dispatch megabatch k+1 (upload +
+        # kernel enqueue are async) BEFORE collecting k, so host
+        # packing and the tunnel's upload latency overlap device
+        # compute -- the async analog of the reference's threaded
+        # per-device batch queues (src/cuda/cudapolisher.cpp:257-336).
+        # RACON_TPU_PIPE_DEPTH (default 2) sets how many megabatches
+        # stay in flight; results apply in FIFO order, so output stays
+        # deterministic.
+        from racon_tpu.tpu import align_pallas as _ap
+        depth = _ap.pipeline_depth()
+        pipe = deque()          # (idxs, collect_fn) FIFO
         mark = _time.monotonic()
 
         def apply(idxs, collect, record=True):
@@ -374,26 +752,26 @@ class TPUPolisher(Polisher):
             if not idxs:
                 break
             batch = [self.windows[i] for i in idxs]
+            self._note_poa_dispatch()
             if not engine.will_dispatch_async(batch):
                 # the lockstep fallback runs synchronously at dispatch
                 # time: drain the pipeline first so the in-flight
                 # batch's measured interval stays honest, and skip
                 # recording the lockstep batch (its engine rate is not
                 # the full-device rate the calibration models)
-                if pending is not None:
-                    apply(*pending)
-                    pending = None
+                while pipe:
+                    apply(*pipe.popleft())
                 collect = engine.consensus_batch_async(
                     batch, self.trim, pool=self._pool)
                 apply(idxs, collect, record=False)
                 continue
             collect = engine.consensus_batch_async(batch, self.trim,
                                                    pool=self._pool)
-            if pending is not None:
-                apply(*pending)
-            pending = (idxs, collect)
-        if pending is not None:
-            apply(*pending)
+            pipe.append((idxs, collect))
+            while len(pipe) >= depth:
+                apply(*pipe.popleft())
+        while pipe:
+            apply(*pipe.popleft())
         for fut in workers:
             fut.result()
 
@@ -524,18 +902,39 @@ class TPUPolisher(Polisher):
         _spawn_prewarm(work, "racon-poa-prewarm")
 
     def find_overlap_breaking_points(self, overlaps: List[Overlap]) -> None:
-        if self.tpu_aligner_batches > 0:
-            import time
-            from jax.profiler import TraceAnnotation
-            self._prewarm_poa_async(overlaps)
-            t0 = time.monotonic()
-            with TraceAnnotation("racon_tpu.device_align"):
-                self._device_align_overlaps(overlaps)
-            self.stage_walls["device_align"] = time.monotonic() - t0
-        # CPU path computes breaking points for everything, running the
-        # CPU aligner only for overlaps still lacking a CIGAR
-        # (cudapolisher.cpp:212-216)
-        super().find_overlap_breaking_points(overlaps)
+        self._align_device_free.clear()
+        self._pipeline_mode = (self._pipeline_enabled()
+                               and self._targets_size > 0)
+        if self._pipeline_mode:
+            self._pipeline_begin(overlaps)
+        try:
+            if self.tpu_aligner_batches > 0:
+                import time
+                from jax.profiler import TraceAnnotation
+                self._prewarm_poa_async(overlaps)
+                t0 = time.monotonic()
+                with TraceAnnotation("racon_tpu.device_align"):
+                    self._device_align_overlaps(overlaps)
+                self.stage_walls["device_align"] = time.monotonic() - t0
+            else:
+                # no device align work: speculative POA megabatches
+                # may dispatch immediately and overlap the CPU align
+                self._mark_align_device_free()
+            if self._pipeline_mode:
+                self._drain_stream_decodes()
+            # CPU path computes breaking points for everything, running
+            # the CPU aligner only for overlaps still lacking a CIGAR
+            # (cudapolisher.cpp:212-216); its per-overlap hook advances
+            # the streaming ledger for anything not already notified
+            super().find_overlap_breaking_points(overlaps)
+        finally:
+            # never leaves the consumer running on an error path; the
+            # raise of any swallowed streaming error happens OUTSIDE
+            # the finally so a propagating exception is not masked
+            errs = (self._pipeline_align_done()
+                    if self._pipeline_mode else [])
+        if errs:
+            raise errs[0]
 
     @staticmethod
     def _bucket_dim(n: int) -> int:
@@ -573,6 +972,7 @@ class TPUPolisher(Polisher):
                 continue  # CPU fallback
             pending.append((max(lq, lt), o))
         if not pending:
+            self._mark_align_device_free()
             return
         pending.sort(key=lambda x: -x[0])
         from racon_tpu.tpu import align_pallas as _ap
@@ -580,6 +980,7 @@ class TPUPolisher(Polisher):
             self._hybrid_pallas_align(pending)
         else:
             self._hybrid_scan_align(pending)
+        self._mark_align_device_free()
 
     def _probe_divergence(self, pending, cpu_ops) -> float:
         """CPU-align a deterministic spread of ~9 pending pairs and
@@ -605,6 +1006,7 @@ class TPUPolisher(Polisher):
             cigar, dist = cpu_ops.align_with_distance(q, t)
             o.cigar = cigar
             o.find_breaking_points(self.sequences, self.window_length)
+            self._notify_overlap_done(o)
             return dist / max(d, 1)
 
         ratios = sorted(self._pool.map(one, idxs))
@@ -708,6 +1110,7 @@ class TPUPolisher(Polisher):
                 o.find_breaking_points(self.sequences,
                                        self.window_length,
                                        aligner=cpu_ops.align)
+                self._notify_overlap_done(o)
                 with lock:
                     meas["cpu_w"] += _time.monotonic() - t1
                     meas["cpu_u"] += cpu_cells(float(d))
@@ -717,6 +1120,9 @@ class TPUPolisher(Polisher):
         if cut:
             self._align_disp = []
             self._pallas_align([o for _, o in pending[:cut]])
+        # device share fully dispatched: speculative POA megabatches
+        # may now queue behind it while the CPU workers drain
+        self._mark_align_device_free()
         for f in workers:
             f.result()
         # the WFA-shaped CPU rate (ns per modeled cell) transfers
@@ -802,6 +1208,7 @@ class TPUPolisher(Polisher):
                 o.find_breaking_points(self.sequences,
                                        self.window_length,
                                        aligner=cpu_ops.align)
+                self._notify_overlap_done(o)
 
         workers = [self._pool.submit(cpu_worker)
                    for _ in range(n_workers)]
@@ -831,6 +1238,7 @@ class TPUPolisher(Polisher):
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::align] device-aligned "
                 f"{n_done} overlaps (bucket {bd}x{bd})")
+        self._mark_align_device_free()
         for f in workers:
             f.result()
         if n_cpu_done:
@@ -948,6 +1356,7 @@ class TPUPolisher(Polisher):
             return knots[i]
 
         # ---- 1. WFA rungs: distance-scaling device path ----------
+        depth = align_pallas.pipeline_depth()
         for emax in sorted(wfa_groups):
             idx = [i for i in wfa_groups[emax] if i in set(pending)]
             if not idx:
@@ -958,7 +1367,9 @@ class TPUPolisher(Polisher):
                                 bd, emax)))
             max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
             if len(idx) > max_b:
-                max_b = min(max_b, max(8 * n_dev, max_b // 2))
+                # depth chunks in flight => each fits 1/depth of the
+                # HBM budget
+                max_b = min(max_b, max(8 * n_dev, max_b // depth))
             chunks = [idx[c0:c0 + max_b]
                       for c0 in range(0, len(idx), max_b)]
 
@@ -968,25 +1379,21 @@ class TPUPolisher(Polisher):
                     [targets[i] for i in sub], bd, emax,
                     mesh=self.mesh)
 
-            n_cert = 0
+            tally = {"cert": 0, "mark": _time.monotonic()}
             still = set()
-            pending_c = dispatch(chunks[0])
-            t_mark = _time.monotonic()
-            for ci, sub in enumerate(chunks):
-                nxt = dispatch(chunks[ci + 1]) \
-                    if ci + 1 < len(chunks) else None
-                tapes, nents, dists = pending_c()
-                dev_s = getattr(pending_c, "device_s",
-                                lambda: 0.0)()
+
+            def consume(sub, coll, emax=emax, tally=tally,
+                        still=still):
+                tapes, nents, dists = coll()
+                dev_s = getattr(coll, "device_s", lambda: 0.0)()
                 self.align_device_s += dev_s
                 self.align_wfa_device_s += dev_s
-                pending_c = nxt
                 steps = float(sum(min(int(d), emax) for d in dists))
                 if hasattr(self, "_align_disp"):
                     now = _time.monotonic()
                     self._align_disp.append(
-                        ("wfa", emax, now - t_mark, steps))
-                    t_mark = now
+                        ("wfa", emax, now - tally["mark"], steps))
+                    tally["mark"] = now
                 # e-steps actually run x diagonal extent = the honest
                 # cell count for a wavefront engine
                 self.align_cells += int(steps) * (2 * emax + 1)
@@ -996,9 +1403,14 @@ class TPUPolisher(Polisher):
                             tapes[k], int(nents[k]))
                         overlaps[i].cigar_runs = \
                             aligner.ops_to_runs(ops)
-                        n_cert += 1
+                        self._stream_decode(overlaps[i])
+                        tally["cert"] += 1
                     else:
                         still.add(i)
+
+            align_pallas.run_pipelined(chunks, dispatch, consume,
+                                       depth)
+            n_cert = tally["cert"]
             idx_set = set(idx)
             pending = [i for i in pending
                        if i in still or i not in idx_set]
@@ -1036,10 +1448,8 @@ class TPUPolisher(Polisher):
                         int(self.align_mem_budget
                             // align_pallas.per_pair_bytes(bd, wb)))
             max_b = min(max_b, self.MAX_ALIGNMENTS_PER_BATCH)
-            n_cert = 0
-            still = set()
             if len(idx) > max_b:
-                max_b = min(max_b, max(8 * n_dev, max_b // 2))
+                max_b = min(max_b, max(8 * n_dev, max_b // depth))
             chunks = [idx[c0:c0 + max_b]
                       for c0 in range(0, len(idx), max_b)]
 
@@ -1051,23 +1461,20 @@ class TPUPolisher(Polisher):
                     centers=[emp_knots(i) if i in use_emp else None
                              for i in sub])
 
-            pending_c = dispatch(chunks[0])
-            t_mark = _time.monotonic()
-            for ci, sub in enumerate(chunks):
-                nxt = dispatch(chunks[ci + 1]) \
-                    if ci + 1 < len(chunks) else None
-                moves, lens, dists = pending_c()
-                dev_s = getattr(pending_c, "device_s",
-                                lambda: 0.0)()
+            tally = {"cert": 0, "mark": _time.monotonic()}
+            still = set()
+
+            def consume(sub, coll, wb=wb, tally=tally, still=still):
+                moves, lens, dists = coll()
+                dev_s = getattr(coll, "device_s", lambda: 0.0)()
                 self.align_device_s += dev_s
                 self.align_band_device_s += dev_s
-                pending_c = nxt
                 if hasattr(self, "_align_disp"):
                     now = _time.monotonic()
                     self._align_disp.append(
-                        ("band", wb, now - t_mark,
+                        ("band", wb, now - tally["mark"],
                          float(sum(len(queries[i]) for i in sub))))
-                    t_mark = now
+                    tally["mark"] = now
                 self.align_cells += sum(len(queries[i])
                                         for i in sub) * wb
                 for k, i in enumerate(sub):
@@ -1084,9 +1491,14 @@ class TPUPolisher(Polisher):
                             targets[i])
                         overlaps[i].cigar_runs = \
                             aligner.ops_to_runs(ops)
-                        n_cert += 1
+                        self._stream_decode(overlaps[i])
+                        tally["cert"] += 1
                     else:
                         still.add(i)
+
+            align_pallas.run_pipelined(chunks, dispatch, consume,
+                                       depth)
+            n_cert = tally["cert"]
             idx_set = set(idx)
             pending = [i for i in pending
                        if i in still or i not in idx_set]
@@ -1216,3 +1628,7 @@ class TPUPolisher(Polisher):
         for idx, o in enumerate(chunk):
             if idx not in skip:
                 o.cigar_runs = aligner.ops_to_runs(ops[idx])
+                # pipelined mode: breaking points decode on the pool
+                # while the next chunk owns the device, advancing the
+                # streaming ledger (no-op when the pipeline is off)
+                self._stream_decode(o)

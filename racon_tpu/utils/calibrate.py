@@ -102,6 +102,34 @@ def get_rates(stage: str, n_dev: int, default_dev: float,
     return out
 
 
+def predict_walls(align_s: float, poa_s: float,
+                  overlap_s: float = None) -> dict:
+    """Overlap-aware wall predictor for the two-stage polish.
+
+    The pre-r8 budget model was additive (wall ~ align + poa): the
+    stages were strictly ordered.  The streaming pipeline overlaps
+    them, so the model becomes wall ~ align + poa - overlap, floored
+    by max(align, poa) (one stage fully hidden behind the other) --
+    plus the ramp the floor ignores (time until the first target's
+    windows are complete).  ``overlap_s`` is the measured
+    pipeline_overlap_s when available; without it only the bounds are
+    returned.  ``overlap_efficiency`` is the achieved fraction of the
+    maximum hideable wall min(align, poa)."""
+    out = {
+        "additive_wall_s": round(align_s + poa_s, 3),
+        "overlapped_floor_s": round(max(align_s, poa_s), 3),
+    }
+    if overlap_s is not None:
+        overlap_s = max(0.0, min(float(overlap_s),
+                                 min(align_s, poa_s)))
+        out["predicted_wall_s"] = round(
+            max(max(align_s, poa_s), align_s + poa_s - overlap_s), 3)
+        hideable = min(align_s, poa_s)
+        out["overlap_efficiency"] = round(
+            overlap_s / hideable, 3) if hideable > 0 else 0.0
+    return out
+
+
 def store_rates(stage: str, n_dev: int, dev_rate: float,
                 cpu_rate=None, provisional: bool = False) -> None:
     """Persist measured rates (two-pass-then-frozen per machine key +

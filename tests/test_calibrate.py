@@ -122,3 +122,25 @@ def test_provisional_never_degrades_real_sample(calib_dir):
     calibrate.store_rates("poa", 1, 5.0, 9.0, provisional=True)
     dev, _, _ = calibrate.get_rates("poa", 1, 0.13, 2.0)
     assert dev == pytest.approx(0.2)
+
+
+def test_predict_walls_overlap_model():
+    """wall ~ align + poa - overlap, floored at max(align, poa): the
+    r8 overlapped budget model replacing the additive one."""
+    p = calibrate.predict_walls(2.0, 1.5)
+    assert p["additive_wall_s"] == 3.5
+    assert p["overlapped_floor_s"] == 2.0
+    assert "predicted_wall_s" not in p
+
+    p = calibrate.predict_walls(2.0, 1.5, overlap_s=1.0)
+    assert p["predicted_wall_s"] == pytest.approx(2.5)
+    assert p["overlap_efficiency"] == pytest.approx(1.0 / 1.5, abs=1e-3)
+
+    # overlap can never exceed the shorter stage: clamped, wall never
+    # predicted below the floor
+    p = calibrate.predict_walls(2.0, 1.5, overlap_s=99.0)
+    assert p["predicted_wall_s"] == pytest.approx(2.0)
+    assert p["overlap_efficiency"] == pytest.approx(1.0)
+
+    p = calibrate.predict_walls(0.0, 0.0, overlap_s=0.0)
+    assert p["overlap_efficiency"] == 0.0
