@@ -80,43 +80,24 @@ _T_START = time.monotonic()
 # the slack on healthy hosts.  Instead the nominal estimates are
 # scaled by a measured factor: a fixed native edit-distance probe
 # (100 kb pair, 10% divergence, seeded) timed at bench start vs its
-# reference-host wall.  ADVICE r5.
-_REF_PROBE_S = 0.27
-_host_factor_cache = []
+# reference-host wall.  ADVICE r5.  The probe itself now lives in
+# racon_tpu/obs/provenance.py so CLI run reports (--metrics-json)
+# record the same measurement this bench scales its budgets by.
 
 
 def _host_factor() -> float:
-    if _host_factor_cache:
-        return _host_factor_cache[0]
-    factor = 1.0
-    try:
-        import numpy as np
+    from racon_tpu.obs import provenance
 
-        from racon_tpu.ops import cpu
-
-        rng = np.random.default_rng(42)
-        acgt = np.frombuffer(b"ACGT", np.uint8)
-        g = acgt[rng.integers(0, 4, 100_000)]
-        m = g.copy()
-        idx = rng.random(len(m)) < 0.10
-        m[idx] = acgt[rng.integers(0, 4, int(idx.sum()))]
-        q, t = g.tobytes(), m.tobytes()
-        cpu.get_library()                 # build outside the timing
-        best = None
-        for _ in range(3):
-            t0 = time.perf_counter()
-            cpu.edit_distance(q, t)
-            dt = time.perf_counter() - t0
-            best = dt if best is None else min(best, dt)
-        # never tighten below the nominal estimates; cap the slack a
-        # pathological host can claim
-        factor = min(max(best / _REF_PROBE_S, 1.0), 4.0)
-        log(f"[bench] host-capability probe {best:.3f}s "
-            f"(ref {_REF_PROBE_S}s) -> budget factor {factor:.2f}")
-    except Exception as exc:
-        log(f"[bench] host probe failed ({type(exc).__name__}: "
-            f"{exc}); budget factor 1.0")
-    _host_factor_cache.append(factor)
+    probe = provenance.host_probe()
+    factor = probe.get("budget_factor", 1.0)
+    if "error" in probe:
+        log(f"[bench] host probe failed ({probe['error']}); "
+            f"budget factor {factor:.2f}")
+    else:
+        log(f"[bench] host-capability probe "
+            f"{probe['probe_wall_s']:.3f}s "
+            f"(ref {probe['ref_wall_s']}s) -> budget factor "
+            f"{factor:.2f}")
     return factor
 
 
@@ -288,17 +269,21 @@ def main():
             if w2 < accel_wall:
                 accel_wall, accel_out, pol = w2, o2, p2
         accel_dist = accuracy(accel_out)
-        align_s = pol.stage_walls.get("device_align", 0.0)
-        poa_s = pol.stage_walls.get("device_poa", 0.0)
-        align_cps = pol.align_cells / align_s if align_s else 0.0
-        poa_cps = pol.poa_cells / poa_s if poa_s else 0.0
+        # the run's metrics come from the obs registry (the single
+        # source of truth the polisher records into; see
+        # racon_tpu/obs/metrics.py) instead of bench-private tallies
+        m = pol.metrics
+        align_s = m.value("stage_wall_s.device_align", 0.0)
+        poa_s = m.value("stage_wall_s.device_poa", 0.0)
+        align_cps = m.value("align_cells") / align_s if align_s else 0.0
+        poa_cps = m.value("poa_cells") / poa_s if poa_s else 0.0
         log(f"[bench] TPU path (warm): {accel_wall:.2f}s, edit distance "
             f"{accel_dist} (reference CUDA golden 1385, "
             "test/racon_test.cpp:312)")
         retries = getattr(pol, "align_retry_counts", {})
-        wfa_s = getattr(pol, "align_wfa_device_s", 0.0)
-        band_s = getattr(pol, "align_band_device_s", 0.0)
-        overlap_s = getattr(pol, "pipeline_overlap_s", 0.0)
+        wfa_s = m.value("align_wfa_device_s", 0.0)
+        band_s = m.value("align_band_device_s", 0.0)
+        overlap_s = m.value("pipeline_overlap_s", 0.0)
         from racon_tpu.utils import calibrate
         pred = calibrate.predict_walls(align_s, poa_s, overlap_s)
         log(f"[bench] pipeline overlap: {overlap_s:.2f}s of the POA "
@@ -307,8 +292,8 @@ def main():
             f"additive model {pred['additive_wall_s']:.2f}s, "
             f"overlapped floor {pred['overlapped_floor_s']:.2f}s, "
             f"spec windows used/wasted "
-            f"{getattr(pol, 'poa_spec_used', 0)}/"
-            f"{getattr(pol, 'poa_spec_wasted', 0)})")
+            f"{int(m.value('poa_spec_used'))}/"
+            f"{int(m.value('poa_spec_wasted'))})")
         log(f"[bench] stage device_align: {align_s:.2f}s wall / "
             f"{pol.align_device_s:.2f}s device "
             f"(wfa {wfa_s:.2f}s, band {band_s:.2f}s), "
@@ -331,6 +316,7 @@ def main():
             for o in warm_outs[1:])
         log(f"[bench] TPU path deterministic across runs: "
             f"{deterministic}")
+        from racon_tpu.obs import REGISTRY
         extra = {
             "cold_wall_s": round(cold_wall, 3),
             "deterministic": deterministic,
@@ -339,24 +325,33 @@ def main():
             # host-independent per-dispatch device time (watcher-
             # thread spans): a kernel regression moves these even
             # when host jitter hides it in the stage walls
-            "align_device_s": round(pol.align_device_s, 3),
+            "align_device_s": round(m.value("align_device_s"), 3),
             # per-ENGINE device align time: the wavefront (WFA)
             # kernel scales with distance, the banded kernel with
             # band x rows -- the split shows which engine owns the
             # align work at this workload's divergence
             "align_wfa_device_s": round(wfa_s, 3),
             "align_band_device_s": round(band_s, 3),
-            "poa_device_s": round(pol.poa_device_s, 3),
+            "poa_device_s": round(m.value("poa_device_s"), 3),
             "align_gcells_per_s": round(align_cps / 1e9, 3),
             "poa_gcells_per_s": round(poa_cps / 1e9, 3),
             "shelf_cold_misses": len(cold_misses),
+            # first-contact shelf outcomes, from the process-wide
+            # registry (racon_tpu/utils/aot_shelf.py records them)
+            "shelf_contacts": {
+                k: int(REGISTRY.value(f"aot_shelf_{k}"))
+                for k in ("hit", "miss", "fallback")},
             # streaming pipeline: how much of the POA span ran inside
             # the align stage (wall ~ align + poa - overlap), plus the
             # speculative-scheduling adoption counters and the split
             # decision inputs (ISSUE r8: explain capped device share)
             "pipeline_overlap_s": round(overlap_s, 3),
-            "poa_spec_used": int(getattr(pol, "poa_spec_used", 0)),
-            "poa_spec_wasted": int(getattr(pol, "poa_spec_wasted", 0)),
+            "poa_spec_used": int(m.value("poa_spec_used")),
+            "poa_spec_wasted": int(m.value("poa_spec_wasted")),
+            "poa_spec_megabatches": int(
+                m.value("poa_spec_megabatches")),
+            "ledger_ready_high_water": int(
+                m.value("ledger_ready_high_water")),
             "poa_split_detail": getattr(pol, "poa_split_detail", {}),
         }
         tpu_ok = True
@@ -556,27 +551,31 @@ def _mega_leg(prefix, label, sim_kwargs, tpu_need_s, cpu_need_s,
         tpu_wall, tpu_out, tpol = run(1, 1)
         d_tpu = cpu.edit_distance(tpu_out[0].data, truth)
         rejects = sum(tpol.poa_reject_counts.values())
+        # per-run obs registry: the single store the polisher records
+        # into (racon_tpu/obs) -- no bench-private tallies
+        tm = tpol.metrics
         out = {
             f"{prefix}_tpu_wall_s": round(tpu_wall, 3),
             f"{prefix}_tpu_edit_distance": int(d_tpu),
             f"{prefix}_poa_rejects": int(rejects),
             f"{prefix}_device_window_share": round(
-                tpol.poa_device_windows
-                / max(tpol.poa_eligible_windows, 1), 3),
-            f"{prefix}_poa_device_s": round(tpol.poa_device_s, 3),
+                tm.value("poa_device_windows")
+                / max(tm.value("poa_eligible_windows"), 1), 3),
+            f"{prefix}_poa_device_s": round(
+                tm.value("poa_device_s"), 3),
             f"{prefix}_align_device_s": round(
-                tpol.align_device_s, 3),
+                tm.value("align_device_s"), 3),
             # per-engine split: at ONT divergence the WFA engine
             # should own the majority of device align work (its cost
             # scales with distance where the band pays band x rows)
             f"{prefix}_align_wfa_device_s": round(
-                getattr(tpol, "align_wfa_device_s", 0.0), 3),
+                tm.value("align_wfa_device_s"), 3),
             f"{prefix}_align_band_device_s": round(
-                getattr(tpol, "align_band_device_s", 0.0), 3),
+                tm.value("align_band_device_s"), 3),
             f"{prefix}_pipeline_overlap_s": round(
-                getattr(tpol, "pipeline_overlap_s", 0.0), 3),
+                tm.value("pipeline_overlap_s"), 3),
             f"{prefix}_poa_spec_used": int(
-                getattr(tpol, "poa_spec_used", 0)),
+                tm.value("poa_spec_used")),
             f"{prefix}_poa_split_detail": getattr(
                 tpol, "poa_split_detail", {}),
         }

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Observability tier-1 leg (ISSUE 4 CI satellite):
+#
+#   1. grep lint: raw time.monotonic()/perf_counter() timing added
+#      anywhere in racon_tpu/ OUTSIDE racon_tpu/obs/ and
+#      utils/logger.py fails the leg — all pipeline timing must route
+#      through the obs layer (racon_tpu.obs.now/span), so the trace
+#      and the metrics registry stay the single timing story.  The
+#      in-suite twin is tests/test_obs.py::test_no_raw_timing_outside_obs.
+#
+#   2. e2e with tracing + metrics-json enabled: the obs test module
+#      runs the device-path polish under RACON_TPU_TRACE and the CLI
+#      under --trace/--metrics-json, validates the emitted Chrome
+#      trace and run report against the schema, and asserts the
+#      traced bytes equal the untraced bytes.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+echo "[obs_tier1] lint: raw timing outside racon_tpu/obs"
+bad=$(grep -rnE 'time\.monotonic\(|time\.perf_counter\(' \
+        --include='*.py' racon_tpu/ \
+      | grep -v '^racon_tpu/obs/' \
+      | grep -v '^racon_tpu/utils/logger\.py' || true)
+if [ -n "$bad" ]; then
+    echo "[obs_tier1] FAIL: raw timing outside the obs layer" \
+         "(use racon_tpu.obs.now()/span()):"
+    echo "$bad"
+    exit 1
+fi
+echo "[obs_tier1] lint clean"
+
+ci/common/build.sh
+python -m pytest tests/test_obs.py tests/test_pipeline.py -q \
+    -m "not slow" -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"

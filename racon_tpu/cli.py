@@ -79,6 +79,12 @@ USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequenc
         --tpualigner-batches <int>
             default: 0
             number of batches for TPU accelerated alignment
+        --trace <file>
+            write a Chrome trace-event JSON of the run (loadable in
+            Perfetto / chrome://tracing); RACON_TPU_TRACE equivalent
+        --metrics-json <file>
+            write the run report (metrics registry + environment
+            provenance); RACON_TPU_METRICS_JSON equivalent
 """
 
 
@@ -90,6 +96,11 @@ def parse_args(argv):
         "gap": -4, "threads": 1, "type": PolisherType.kC,
         "drop_unpolished": True, "tpu_poa_batches": 0,
         "tpu_banded_alignment": False, "tpu_aligner_batches": 0,
+        # observability (racon_tpu/obs): env defaults keep library
+        # and CLI runs on one switch
+        "trace": os.environ.get("RACON_TPU_TRACE") or None,
+        "metrics_json": os.environ.get("RACON_TPU_METRICS_JSON")
+        or None,
     }
     positionals = []
     i = 0
@@ -146,6 +157,14 @@ def parse_args(argv):
             opts["tpu_aligner_batches"] = int(take_value(a))
         elif a.startswith("--tpualigner-batches="):
             opts["tpu_aligner_batches"] = int(a.split("=", 1)[1])
+        elif a == "--trace":
+            opts["trace"] = take_value(a)
+        elif a.startswith("--trace="):
+            opts["trace"] = a.split("=", 1)[1]
+        elif a == "--metrics-json":
+            opts["metrics_json"] = take_value(a)
+        elif a.startswith("--metrics-json="):
+            opts["metrics_json"] = a.split("=", 1)[1]
         elif a == "--version":
             print(__version__)
             raise SystemExit(0)
@@ -163,6 +182,25 @@ def parse_args(argv):
     return opts, positionals
 
 
+def _log_run_summary(polisher, opts) -> None:
+    """One-line end-of-run health summary at default verbosity: the
+    speculative-pipeline counters (adopted vs wasted speculation, the
+    ledger's ready-queue high-water mark) used to be visible only
+    inside bench runs; a production polish should say whether its
+    speculation paid off without re-running under bench.py."""
+    m = getattr(polisher, "metrics", None)
+    if m is None or opts["tpu_poa_batches"] <= 0:
+        return
+    print("[racon_tpu::] pipeline summary: "
+          f"spec used {int(m.value('poa_spec_used'))}"
+          f"/wasted {int(m.value('poa_spec_wasted'))} window(s), "
+          f"ledger ready peak {int(m.value('ledger_ready_high_water'))}, "
+          f"overlap {float(m.value('pipeline_overlap_s')):.2f} s, "
+          f"device poa {float(m.value('poa_device_s')):.2f} s / "
+          f"align {float(m.value('align_device_s')):.2f} s",
+          file=sys.stderr)
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     try:
@@ -176,6 +214,12 @@ def main(argv=None):
         print("[racon_tpu::] error: missing input file(s)!", file=sys.stderr)
         print(USAGE, end="", file=sys.stderr)
         raise SystemExit(1)
+
+    from racon_tpu import obs
+    if opts["trace"]:
+        # exported to the environment too, so every module (and the
+        # prewarm threads spawned below) sees one switch
+        obs.enable_trace(opts["trace"])
 
     if opts["tpu_poa_batches"] > 0 or opts["tpu_aligner_batches"] > 0:
         # kick off the AOT-shelf prewarm NOW, before the (multi-second)
@@ -197,9 +241,11 @@ def main(argv=None):
             opts["mismatch"], opts["gap"], opts["threads"],
             opts["tpu_poa_batches"], opts["tpu_banded_alignment"],
             opts["tpu_aligner_batches"])
-        polisher.initialize()
-        polished = polisher.polish(opts["drop_unpolished"])
+        with obs.span("racon_tpu.run", cat="stage"):
+            polisher.initialize()
+            polished = polisher.polish(opts["drop_unpolished"])
         polisher.total_log()
+        _log_run_summary(polisher, opts)
     except (InvalidInputError, UnsupportedFormatError,
             MalformedInputError, FileNotFoundError) as exc:
         print(f"[racon_tpu::] error: {exc}", file=sys.stderr)
@@ -215,6 +261,35 @@ def main(argv=None):
     # (ADVICE r5)
     sys.stdout.flush()
     out.flush()
+    # run report + trace: written AFTER the polished bytes are safely
+    # flushed (the stdout contract comes first) and BEFORE the hard
+    # exit below would discard them
+    if opts["metrics_json"]:
+        from racon_tpu.obs import provenance
+        provenance.write_metrics_json(
+            opts["metrics_json"], run_registry=polisher.metrics,
+            details={
+                "stage_walls": {
+                    k: round(v, 6) for k, v in
+                    getattr(polisher, "stage_walls", {}).items()},
+                "poa_split_detail": getattr(polisher,
+                                            "poa_split_detail", {}),
+                "align_retry_counts": {
+                    str(k): v for k, v in
+                    getattr(polisher, "align_retry_counts",
+                            {}).items()},
+                "poa_reject_counts": {
+                    str(k): v for k, v in
+                    getattr(polisher, "poa_reject_counts",
+                            {}).items()},
+            })
+        print(f"[racon_tpu::] metrics report written to "
+              f"{opts['metrics_json']}", file=sys.stderr)
+    if obs.TRACER.enabled and obs.TRACER.out_path():
+        path = obs.write_trace()
+        print(f"[racon_tpu::] trace written to {path} "
+              "(open in Perfetto / chrome://tracing)",
+              file=sys.stderr)
     # hard-exit once the output is flushed: background prewarm
     # compiles may still be in flight, and waiting for them (or
     # letting interpreter teardown abort them mid-C++-call) serves no

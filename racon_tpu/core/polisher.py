@@ -20,6 +20,8 @@ from racon_tpu.core.sequence import Sequence
 from racon_tpu.core.window import Window, WindowType
 from racon_tpu.io.parsers import (create_overlap_parser,
                                   create_sequence_parser)
+from racon_tpu.obs import REGISTRY, Registry
+from racon_tpu.obs import trace as obs_trace
 from racon_tpu.ops import cpu
 from racon_tpu.utils.logger import Logger
 
@@ -96,6 +98,11 @@ class Polisher:
         self._targets_size = 0
         self._coverage_counted = False
         self.dummy_quality = b"!" * window_length
+        # per-run metrics registry (racon_tpu/obs): every counter this
+        # run records also propagates into the process-wide REGISTRY,
+        # so bench.py reads per-polish numbers here and the CLI's
+        # --metrics-json report is assembled from the same store
+        self.metrics = Registry(parent=REGISTRY)
         self.engine = cpu.PoaEngine(match, mismatch, gap)
         self.logger = Logger()
         self._pool = concurrent.futures.ThreadPoolExecutor(
@@ -112,8 +119,9 @@ class Polisher:
             return
 
         self.logger.log()
-        self.tparser.reset()
-        self.tparser.parse(self.sequences, -1)
+        with obs_trace.span("racon_tpu.load_targets", cat="stage"):
+            self.tparser.reset()
+            self.tparser.parse(self.sequences, -1)
         targets_size = len(self.sequences)
         if targets_size == 0:
             raise InvalidInputError("empty target sequences set!")
@@ -154,6 +162,7 @@ class Polisher:
         sequences_size = 0
         total_sequences_length = 0
         self.sparser.reset()
+        _t_seq = obs_trace.now()
         while True:
             chunk_start = len(self.sequences)
             status = self.sparser.parse(self.sequences, CHUNK_SIZE)
@@ -184,6 +193,8 @@ class Polisher:
             self.sequences.extend(kept)
             if not status:
                 break
+        obs_trace.TRACER.add_span("racon_tpu.load_sequences", _t_seq,
+                                  obs_trace.now(), cat="stage")
 
         if sequences_size == 0:
             raise InvalidInputError("empty sequences set!")
@@ -205,8 +216,9 @@ class Polisher:
         self.logger.log("[racon_tpu::Polisher::initialize] loaded sequences")
         self.logger.log()
 
-        overlaps = self._load_overlaps(name_to_id, id_to_id, has_data,
-                                       has_reverse_data)
+        with obs_trace.span("racon_tpu.load_overlaps", cat="stage"):
+            overlaps = self._load_overlaps(name_to_id, id_to_id,
+                                           has_data, has_reverse_data)
         # a multi-host rank may legitimately own zero overlaps (its
         # targets drew none); only single-process runs treat an empty
         # set as invalid input
@@ -218,15 +230,20 @@ class Polisher:
 
         # materialise reverse complements in the pool
         # (reference: src/polisher.cpp:368-377)
-        list(self._pool.map(
-            lambda args: args[0].transmute(*args[1:]),
-            [(s, has_name[j], has_data[j], has_reverse_data[j])
-             for j, s in enumerate(self.sequences)]))
+        with obs_trace.span("racon_tpu.transmute", cat="stage"):
+            list(self._pool.map(
+                lambda args: args[0].transmute(*args[1:]),
+                [(s, has_name[j], has_data[j], has_reverse_data[j])
+                 for j, s in enumerate(self.sequences)]))
 
-        self.find_overlap_breaking_points(overlaps)
+        with obs_trace.span("racon_tpu.align_stage", cat="stage",
+                            metric="stage_wall_s.align",
+                            registry=self.metrics):
+            self.find_overlap_breaking_points(overlaps)
 
         self.logger.log()
-        self._build_windows(targets_size, window_type, overlaps)
+        with obs_trace.span("racon_tpu.build_windows", cat="stage"):
+            self._build_windows(targets_size, window_type, overlaps)
         self.logger.log("[racon_tpu::Polisher::initialize] transformed data "
                         "into windows")
 
@@ -442,7 +459,10 @@ class Polisher:
 
     def polish(self, drop_unpolished_sequences: bool) -> List[Sequence]:
         self.logger.log()
-        polished_flags = self.generate_consensuses()
+        with obs_trace.span("racon_tpu.consensus_stage", cat="stage",
+                            metric="stage_wall_s.consensus",
+                            registry=self.metrics):
+            polished_flags = self.generate_consensuses()
 
         dst: List[Sequence] = []
         polished_data = bytearray()

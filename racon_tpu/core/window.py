@@ -57,6 +57,10 @@ class WindowLedger:
         # window id -> [(ordinal, fragment...), ...]
         self._stash: Dict[int, list] = {}
         self.ready: deque = deque()
+        # ready-queue high-water mark: how deep the speculative POA
+        # consumer's backlog ever got (obs metric
+        # ledger_ready_high_water; the polisher publishes it)
+        self.ready_high_water = 0
         self._sealed = False
         self.n_completed = 0
 
@@ -111,6 +115,8 @@ class WindowLedger:
             return
         with self.cond:
             self.ready.extend(wids)
+            self.ready_high_water = max(self.ready_high_water,
+                                        len(self.ready))
             self.cond.notify_all()
 
     def pop_ready(self, cap: int, min_n: int = 1) -> List[int]:

@@ -1,0 +1,47 @@
+"""Unified trace/metrics subsystem for the streaming pipeline.
+
+The reference instruments its GPU path with nvprof ranges
+(src/cuda/cudapolisher.cpp:66-70) plus a stage ``Logger``; after the
+r8 streaming pipeline this codebase is a concurrent system (align
+ladder + speculative POA consumer + watcher threads + double-buffered
+dispatch) whose timing story needs first-class tooling:
+
+* :mod:`racon_tpu.obs.trace` — a thread-safe span tracer emitting
+  **Chrome trace-event JSON** (loadable in Perfetto /
+  ``chrome://tracing``).  Spans are nested per thread (stage → rung →
+  megabatch → chunk) and device dispatches get their own virtual
+  "device" lanes fed by the watcher threads.  Device-stage spans also
+  enter ``jax.profiler.TraceAnnotation`` so a jax/Perfetto device
+  profile correlates with the host spans by name.
+* :mod:`racon_tpu.obs.metrics` — a process-wide metrics registry
+  (counters / gauges / histograms) that is the single source of truth
+  for every number ``bench.py`` used to tally privately:
+  ``poa_device_s``, ``align_wfa_device_s`` / ``align_band_device_s``,
+  ``pipeline_overlap_s``, ``poa_spec_used`` / ``poa_spec_wasted``,
+  AOT-shelf hit/miss/fallback, ladder rung admissions/retries, the
+  WindowLedger ready-queue high-water mark.  Each polisher owns a
+  per-run child registry that propagates into the global one.
+* :mod:`racon_tpu.obs.provenance` — per-run environment provenance
+  (resolved ``RACON_TPU_*`` knobs, jax backend, host-capability
+  probe) and the ``--metrics-json`` run-report writer.
+
+Determinism contract: clocks here feed ONLY the trace and the
+metrics, never control flow — a tracing-enabled run emits
+byte-identical output to a tracing-off run (pinned by
+tests/test_obs.py and tests/test_pipeline.py).
+
+All raw timing in ``racon_tpu/`` goes through :func:`now` (the lint in
+ci/cpu/obs_tier1.sh and tests/test_obs.py fails on raw
+``time.monotonic`` calls outside this package and utils/logger.py).
+"""
+
+from __future__ import annotations
+
+from racon_tpu.obs.metrics import REGISTRY, MetricAttr, Registry
+from racon_tpu.obs.trace import (TRACER, device_span, enable_trace, now,
+                                 span, write_trace)
+
+__all__ = [
+    "REGISTRY", "Registry", "MetricAttr", "TRACER",
+    "now", "span", "device_span", "enable_trace", "write_trace",
+]

@@ -1,0 +1,134 @@
+"""Run-wide metrics registry (counters / gauges / histograms).
+
+One process-global :data:`REGISTRY` plus per-run child registries
+(each :class:`~racon_tpu.core.polisher.Polisher` owns one): every
+write to a child also propagates to its parent, so a multi-polish
+process (bench.py, a serving loop) reads per-run numbers from the
+polisher's registry and process totals from the global one.
+
+Only the writers mutate state; readers get plain numbers /
+JSON-serializable dicts.  Nothing here feeds control flow — the
+registry records what happened, it never decides what happens
+(determinism contract, see racon_tpu/obs/__init__.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class Registry:
+    """Thread-safe metrics store.
+
+    * ``add(name, v)``    — counter: accumulate (default +1)
+    * ``set(name, v)``    — gauge: overwrite
+    * ``peak(name, v)``   — gauge: keep the maximum (high-water mark)
+    * ``observe(name, v)``— histogram: count/sum/min/max
+    * ``value(name)``     — read a counter or gauge
+    * ``timer(name)``     — context manager adding elapsed seconds to
+                            the counter ``name``
+    * ``snapshot()``      — JSON-serializable dict of everything
+    """
+
+    def __init__(self, parent: Optional["Registry"] = None):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Dict[str, float]] = {}
+        self.parent = parent
+
+    # -- writers -------------------------------------------------------
+
+    def add(self, name: str, value=1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+        if self.parent is not None:
+            self.parent.add(name, value)
+
+    def set(self, name: str, value) -> None:
+        with self._lock:
+            self._gauges[name] = value
+        if self.parent is not None:
+            self.parent.set(name, value)
+
+    def peak(self, name: str, value) -> None:
+        with self._lock:
+            if value > self._gauges.get(name, value - 1):
+                self._gauges[name] = value
+        if self.parent is not None:
+            self.parent.peak(name, value)
+
+    def observe(self, name: str, value) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = {
+                    "count": 0, "sum": 0.0,
+                    "min": float(value), "max": float(value)}
+            h["count"] += 1
+            h["sum"] += float(value)
+            h["min"] = min(h["min"], float(value))
+            h["max"] = max(h["max"], float(value))
+        if self.parent is not None:
+            self.parent.observe(name, value)
+
+    @contextmanager
+    def timer(self, name: str):
+        from racon_tpu.obs.trace import now
+
+        t0 = now()
+        try:
+            yield
+        finally:
+            self.add(name, now() - t0)
+
+    # -- readers -------------------------------------------------------
+
+    def value(self, name: str, default=0):
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges.get(name, default)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: dict(v)
+                               for k, v in self._hists.items()},
+            }
+
+    def reset(self) -> None:
+        """Clear this registry only (the parent keeps its totals)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+class MetricAttr:
+    """Class attribute backed by the instance's per-run registry.
+
+    ``obj.<attr>`` reads ``obj.metrics.value(name)``; assignment (and
+    therefore ``+=``) writes through ``obj.metrics.set`` — the
+    attribute IS the registry entry, so the polisher's public counters
+    (``poa_device_s``, ``poa_spec_used``, ...) and the ``--metrics-json``
+    run report can never disagree."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __get__(self, obj, owner=None):
+        if obj is None:
+            return self
+        return obj.metrics.value(self.name)
+
+    def __set__(self, obj, value):
+        obj.metrics.set(self.name, value)
+
+
+#: process-wide registry (parent of every per-run registry)
+REGISTRY = Registry()

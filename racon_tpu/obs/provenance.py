@@ -1,0 +1,164 @@
+"""Per-run environment provenance + the ``--metrics-json`` run report.
+
+A BENCH_*.json trajectory is only reproducible from a run artifact if
+the artifact records the environment that produced it: the resolved
+``RACON_TPU_*`` knob values (env-set AND defaulted), the jax backend,
+and the host-capability probe bench.py scales its wall budgets by.
+:func:`write_metrics_json` emits one self-describing JSON document:
+
+    {"schema": "racon-tpu-metrics-v1",
+     "environment": {knobs, jax, host},
+     "run": <per-run registry snapshot>,
+     "process": <global registry snapshot>,
+     "details": {...}}                      # free-form (split detail &c)
+
+BASELINE.md's budget-model terms map 1:1 onto the ``run`` section's
+metric names (see BASELINE.md "Observability: metric names").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+#: knob catalog: name -> default as the code resolves it ("" = unset).
+#: Swept IN ADDITION to any RACON_TPU_* actually present in the
+#: environment, so pinned rates and ad-hoc overrides always appear.
+KNOWN_KNOBS = {
+    "RACON_TPU_PIPELINE": "1",
+    "RACON_TPU_PIPE_DEPTH": "2",
+    "RACON_TPU_PIPE_MIN": "32",
+    "RACON_TPU_CLI_PREWARM": "1",
+    "RACON_TPU_POA_MEGABATCH": "256",
+    "RACON_TPU_POA_BATCH": "0",
+    "RACON_TPU_POA_SWIN": "",
+    "RACON_TPU_POA_KRANK": "",
+    "RACON_TPU_ALIGN_BUDGET": str(2 << 30),
+    "RACON_TPU_MAX_ALIGN_DIM": "16384",
+    "RACON_TPU_WFA": "1",
+    "RACON_TPU_WFA_EMAX": "2048",
+    "RACON_TPU_WFA_MAX_MB": "256",
+    "RACON_TPU_NO_PALLAS": "",
+    "RACON_TPU_PALLAS_INTERPRET": "",
+    "RACON_TPU_STEAL": "",
+    "RACON_TPU_POA_SPLIT": "",
+    "RACON_TPU_ALIGN_SPLIT": "",
+    "RACON_TPU_POA_DEVICE_ONLY": "",
+    "RACON_TPU_ALIGN_DEVICE_ONLY": "",
+    "RACON_TPU_RECALIBRATE": "",
+    "RACON_TPU_CACHE_DIR": "",
+    "RACON_TPU_TRACE": "",
+    "RACON_TPU_METRICS_JSON": "",
+}
+
+# host-capability probe reference wall (bench.py's budget scaling):
+# a fixed native edit-distance probe (100 kb pair, 10% divergence,
+# seeded) measured on the r6 reference host
+REF_PROBE_S = 0.27
+
+_probe_cache: list = []
+
+
+def resolved_knobs() -> dict:
+    """Every RACON_TPU_* knob with its resolved value and source."""
+    out = {}
+    names = set(KNOWN_KNOBS)
+    names.update(k for k in os.environ if k.startswith("RACON_TPU_"))
+    for name in sorted(names):
+        env = os.environ.get(name)
+        out[name] = {
+            "value": env if env is not None
+            else KNOWN_KNOBS.get(name, ""),
+            "source": "env" if env is not None else "default",
+        }
+    return out
+
+
+def jax_info() -> dict:
+    """Backend facts, without forcing a jax import on runs that never
+    touched the device path."""
+    if "jax" not in sys.modules:
+        return {"imported": False}
+    try:
+        import jax
+        devs = jax.devices()
+        return {"imported": True, "version": jax.__version__,
+                "backend": devs[0].platform, "n_devices": len(devs)}
+    except Exception as exc:
+        return {"imported": True,
+                "error": f"{type(exc).__name__}: {exc}"}
+
+
+def host_probe() -> dict:
+    """Measured host capability: best-of-3 wall of a fixed native
+    edit-distance probe vs the reference host, and the wall-budget
+    factor bench.py derives from it.  Cached per process (the probe
+    costs ~0.3-1 s); never raises."""
+    if _probe_cache:
+        return _probe_cache[0]
+    from racon_tpu.obs.trace import now
+
+    out = {"ref_wall_s": REF_PROBE_S}
+    try:
+        import numpy as np
+
+        from racon_tpu.ops import cpu
+
+        rng = np.random.default_rng(42)
+        acgt = np.frombuffer(b"ACGT", np.uint8)
+        g = acgt[rng.integers(0, 4, 100_000)]
+        m = g.copy()
+        idx = rng.random(len(m)) < 0.10
+        m[idx] = acgt[rng.integers(0, 4, int(idx.sum()))]
+        q, t = g.tobytes(), m.tobytes()
+        cpu.get_library()             # build outside the timing
+        best = None
+        for _ in range(3):
+            t0 = now()
+            cpu.edit_distance(q, t)
+            dt = now() - t0
+            best = dt if best is None else min(best, dt)
+        out["probe_wall_s"] = round(best, 4)
+        # never tighten below the nominal estimates; cap the slack a
+        # pathological host can claim
+        out["budget_factor"] = round(
+            min(max(best / REF_PROBE_S, 1.0), 4.0), 3)
+    except Exception as exc:
+        out["error"] = f"{type(exc).__name__}: {exc}"
+        out["budget_factor"] = 1.0
+    _probe_cache.append(out)
+    return out
+
+
+def environment(probe: bool = True) -> dict:
+    env = {
+        "knobs": resolved_knobs(),
+        "jax": jax_info(),
+        "host": {"cpu_count": os.cpu_count(),
+                 "platform": sys.platform},
+    }
+    if probe:
+        env["host"]["capability_probe"] = host_probe()
+    return env
+
+
+def write_metrics_json(path: str, run_registry=None, details=None,
+                       probe: bool = True) -> str:
+    """Write the run report (atomic replace).  Returns ``path``."""
+    from racon_tpu.obs.metrics import REGISTRY
+
+    doc = {
+        "schema": "racon-tpu-metrics-v1",
+        "environment": environment(probe=probe),
+        "run": (run_registry.snapshot()
+                if run_registry is not None else None),
+        "process": REGISTRY.snapshot(),
+    }
+    if details:
+        doc["details"] = details
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+    return path

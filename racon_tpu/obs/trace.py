@@ -1,0 +1,204 @@
+"""Thread-safe span tracer emitting Chrome trace-event JSON.
+
+Spans are recorded as complete ("ph": "X") events with microsecond
+timestamps relative to a process-wide monotonic epoch, attributed to
+the recording thread (Perfetto nests same-thread spans by ts/dur, so
+``with span(...)`` nesting renders as a flame graph per thread).
+Watcher threads record device dispatch intervals onto named virtual
+lanes (``lane="device"``), keeping per-dispatch device time visually
+separate from host work.
+
+Tracing is off by default and costs one dict lookup per span; it is
+enabled by :func:`enable_trace` (the CLI's ``--trace PATH``) or by
+setting ``RACON_TPU_TRACE=PATH`` in the environment (library runs,
+tests).  The recorded buffer is written by :func:`write_trace` —
+recording never touches the filesystem on the hot path.
+
+Determinism: timestamps feed only the emitted JSON, never control
+flow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager, nullcontext
+
+#: the one sanctioned monotonic clock for racon_tpu timing (see the
+#: obs lint); trace timestamps are offsets from _EPOCH in microseconds
+now = time.monotonic
+
+_EPOCH = time.monotonic()
+
+
+def _us(t: float) -> float:
+    return (t - _EPOCH) * 1e6
+
+
+class Tracer:
+    # virtual lanes get tids above this floor so they sort after the
+    # real threads in the Perfetto track list
+    _LANE_TID0 = 1 << 20
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: list = []
+        self._enabled = False
+        self._path = None
+        self._pid = os.getpid()
+        self._tids: dict = {}        # thread ident -> small tid
+        self._lanes: dict = {}       # lane name -> virtual tid
+
+    # -- gating --------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled or bool(os.environ.get("RACON_TPU_TRACE"))
+
+    def enable(self, path: str) -> None:
+        self._enabled = True
+        self._path = path
+
+    def out_path(self):
+        return self._path or os.environ.get("RACON_TPU_TRACE") or None
+
+    # -- recording -----------------------------------------------------
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = self._tids[ident] = len(self._tids) + 1
+                self._events.append({
+                    "name": "thread_name", "ph": "M", "pid": self._pid,
+                    "tid": tid,
+                    "args": {"name": threading.current_thread().name}})
+        return tid
+
+    def _lane_tid(self, lane: str) -> int:
+        with self._lock:
+            tid = self._lanes.get(lane)
+            if tid is None:
+                tid = self._lanes[lane] = \
+                    self._LANE_TID0 + len(self._lanes)
+                self._events.append({
+                    "name": "thread_name", "ph": "M", "pid": self._pid,
+                    "tid": tid, "args": {"name": lane}})
+        return tid
+
+    def add_span(self, name: str, t0: float, t1: float,
+                 cat: str = "host", lane: str = None,
+                 args: dict = None) -> None:
+        """Record an already-measured [t0, t1] interval (monotonic
+        seconds) — the watcher-thread path, and the retroactive path
+        for loops that already keep their own marks."""
+        if not self.enabled:
+            return
+        tid = self._lane_tid(lane) if lane else self._tid()
+        ev = {"name": name, "ph": "X", "cat": cat, "pid": self._pid,
+              "tid": tid, "ts": _us(t0),
+              "dur": max(0.0, (t1 - t0) * 1e6)}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def add_instant(self, name: str, cat: str = "host",
+                    args: dict = None) -> None:
+        if not self.enabled:
+            return
+        ev = {"name": name, "ph": "i", "s": "t", "cat": cat,
+              "pid": self._pid, "tid": self._tid(), "ts": _us(now())}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    # -- output --------------------------------------------------------
+
+    def write(self, path: str = None) -> str:
+        """Serialize the buffer as Chrome trace-event JSON (Perfetto /
+        chrome://tracing loadable).  Returns the path written."""
+        path = path or self.out_path()
+        if not path:
+            raise ValueError("no trace output path configured")
+        with self._lock:
+            events = list(self._events)
+        doc = {
+            "traceEvents": [{"name": "process_name", "ph": "M",
+                             "pid": self._pid, "tid": 0,
+                             "args": {"name": "racon-tpu"}}] + events,
+            "displayTimeUnit": "ms",
+        }
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._tids.clear()
+            self._lanes.clear()
+
+
+TRACER = Tracer()
+
+
+def enable_trace(path: str) -> None:
+    """Turn tracing on for this process, writing to ``path`` (also
+    exported as RACON_TPU_TRACE so child contexts agree)."""
+    os.environ["RACON_TPU_TRACE"] = path
+    TRACER.enable(path)
+
+
+def write_trace(path: str = None) -> str:
+    return TRACER.write(path)
+
+
+@contextmanager
+def span(name: str, cat: str = "host", args: dict = None,
+         metric: str = None, registry=None):
+    """Trace span around a block; with ``metric`` the elapsed seconds
+    also accumulate into ``registry`` (default: the global registry),
+    whether or not tracing is enabled."""
+    timed = metric is not None or TRACER.enabled
+    t0 = now() if timed else 0.0
+    try:
+        yield
+    finally:
+        if timed:
+            t1 = now()
+            if metric is not None:
+                if registry is None:
+                    from racon_tpu.obs.metrics import REGISTRY \
+                        as registry
+                registry.add(metric, t1 - t0)
+            TRACER.add_span(name, t0, t1, cat=cat, args=args)
+
+
+@contextmanager
+def device_span(name: str, args: dict = None):
+    """Span for a device-offloaded stage: records the host-side span
+    AND enters ``jax.profiler.TraceAnnotation`` (when jax is already
+    importable) so a concurrent jax/Perfetto device profile carries
+    the same range names as the host trace — the nvprof-range analog
+    (src/cuda/cudapolisher.cpp:66-70)."""
+    ann = nullcontext()
+    if "jax" in sys.modules:
+        try:
+            from jax.profiler import TraceAnnotation
+            ann = TraceAnnotation(name)
+        except Exception:
+            ann = nullcontext()
+    t0 = now()
+    try:
+        with ann:
+            yield
+    finally:
+        TRACER.add_span(name, t0, now(), cat="device_stage", args=args)

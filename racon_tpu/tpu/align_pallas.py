@@ -58,6 +58,12 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from racon_tpu.obs import trace as obs_trace
+
+# the sanctioned clock (racon_tpu/obs): watcher spans feed only the
+# trace and the device_s reporting counters, never control flow
+_mono = obs_trace.now
+
 _BIG = 1 << 20
 _CKPT = 128                  # rows between score checkpoints
                              # (halved for wide bands: VMEM dirs block)
@@ -659,7 +665,6 @@ def align_dispatch(queries, targets, lq: int, lt: int, wb: int,
     from racon_tpu.tpu.aligner import encode_batch, _QPAD, _TPAD
 
     import threading
-    import time
 
     n_real = len(queries)
     n_dev = len(mesh.devices) if mesh is not None else 1
@@ -682,7 +687,7 @@ def align_dispatch(queries, targets, lq: int, lt: int, wb: int,
     from racon_tpu.parallel.mesh_utils import interpret_mode
 
     interp = interpret_mode()
-    t_disp = time.monotonic()
+    t_disp = _mono()
     if n_dev > 1:
         tape, meta = _align_sharded(q, t, ql, tl, ctr, mesh=mesh,
                                     lq=lq, lt=lt, wb=wb,
@@ -709,7 +714,13 @@ def align_dispatch(queries, targets, lq: int, lt: int, wb: int,
     def _watch():
         try:
             jax.block_until_ready((tape, meta))
-            span["s"] = time.monotonic() - t_disp
+            t_end = _mono()
+            span["s"] = t_end - t_disp
+            # device-lane trace span: dispatch-enqueue -> outputs
+            # ready, free of host work between dispatch and collect
+            obs_trace.TRACER.add_span(
+                f"device.align_band{wb}", t_disp, t_end, cat="device",
+                lane="device", args={"n": n_real})
         except Exception:
             pass  # dispatch errors surface at collect()
 
@@ -1164,7 +1175,6 @@ def wfa_dispatch(queries, targets, lq: int, emax: int, mesh=None):
     from racon_tpu.tpu.aligner import encode_batch, _QPAD, _TPAD
 
     import threading
-    import time
 
     n_real = len(queries)
     n_dev = len(mesh.devices) if mesh is not None else 1
@@ -1178,7 +1188,7 @@ def wfa_dispatch(queries, targets, lq: int, emax: int, mesh=None):
     from racon_tpu.parallel.mesh_utils import interpret_mode
 
     interp = interpret_mode()
-    t_disp = time.monotonic()
+    t_disp = _mono()
     if n_dev > 1:
         tape, meta = _wfa_sharded(q, t, ql, tl, mesh=mesh, lq=lq,
                                   emax=emax, interpret=interp)
@@ -1198,7 +1208,11 @@ def wfa_dispatch(queries, targets, lq: int, emax: int, mesh=None):
     def _watch():
         try:
             jax.block_until_ready((tape, meta))
-            span["s"] = time.monotonic() - t_disp
+            t_end = _mono()
+            span["s"] = t_end - t_disp
+            obs_trace.TRACER.add_span(
+                f"device.align_wfa{emax}", t_disp, t_end,
+                cat="device", lane="device", args={"n": n_real})
         except Exception:
             pass  # dispatch errors surface at collect()
 

@@ -34,7 +34,6 @@ import ctypes
 import functools
 import os
 import threading
-import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -43,8 +42,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from racon_tpu.obs import trace as obs_trace
 from racon_tpu.ops import cpu as cpu_ops
 from racon_tpu.utils.tuning import poa_band_cols, scan_unroll as _unroll
+
+# the sanctioned clock (racon_tpu/obs): phase walls feed only the
+# engine's reporting counters, never control flow
+_mono = obs_trace.now
 
 _BIG = np.int32(1 << 28)
 
@@ -557,7 +561,7 @@ class TPUPoaBatchEngine:
             max((len(ll) for ll in layer_lists), default=0) + 1, 8))
         b_pad = max(8, pow2_at_least(n, 8))
 
-        t0 = time.monotonic()
+        t0 = _mono()
         seqs = np.zeros((b_pad, d1, lp), np.uint8)
         wts = np.ones((b_pad, d1, lp), np.uint8)
         meta = np.zeros((b_pad, d1, 8), np.int32)
@@ -592,9 +596,9 @@ class TPUPoaBatchEngine:
                              and end > len(bb) - offset) else 0
                 meta[b, d, :4] = (begin, end, full, len(s))
         with self._reject_lock:
-            self.phase_walls["export"] += time.monotonic() - t0
+            self.phase_walls["export"] += _mono() - t0
 
-        t_disp = time.monotonic()
+        t_disp = _mono()
         handle = poa_pallas.poa_full_dispatch(
             seqs, wts, meta, nlay, bblen, v=v, lp=lp, d1=d1,
             p=self.pcap, s=self.pcap, a=8, k=self.kcap, wb=wb,
@@ -603,9 +607,9 @@ class TPUPoaBatchEngine:
             mesh=self.mesh)
 
         def collect():
-            t0 = time.monotonic()
+            t0 = _mono()
             cons, mout = handle()
-            blocked = time.monotonic() - t0
+            blocked = _mono() - t0
             # NOTE under the double-buffered pipeline: "dispatch"
             # counts only the UN-overlapped blocking residual (device
             # time hidden behind the next batch's packing shows up in
@@ -626,14 +630,14 @@ class TPUPoaBatchEngine:
                 lo = int(live.min()) if live.size else 0
                 print(f"[poa-trace] b={n}(pad {b_pad}) d1={d1} "
                       f"depths {lo}..{int(nlay[:n].max())} "
-                      f"span {time.monotonic() - t_disp:.2f}s "
+                      f"span {_mono() - t_disp:.2f}s "
                       f"blocked {blocked:.2f}s",
                       file=sys.stderr, flush=True)
             with self._reject_lock:
                 self.n_rounds += 1
                 self.cells += int(mout[:n, 4].sum()) * wb
 
-            t1 = time.monotonic()
+            t1 = _mono()
             results: List[Tuple[Optional[bytes], bool]] = []
             code_map = {poa_pallas.FAIL_VCAP: -1,
                         poa_pallas.FAIL_EDGE: -2,
@@ -654,7 +658,7 @@ class TPUPoaBatchEngine:
                 results.append(
                     (bytes(cons[b, :length].astype(np.uint8)), True))
             with self._reject_lock:
-                self.phase_walls["extract"] += time.monotonic() - t1
+                self.phase_walls["extract"] += _mono() - t1
             return results
 
         return collect
@@ -718,9 +722,9 @@ class TPUPoaBatchEngine:
                 seq_arr[i, :len(s)] = np.frombuffer(s, dtype=np.uint8)
                 slen[i] = len(s)
 
-            t0 = time.monotonic()
+            t0 = _mono()
             _map(pool, export, active)
-            self.phase_walls["export"] += time.monotonic() - t0
+            self.phase_walls["export"] += _mono() - t0
             active = [i for i in active if not failed[i]]
             if not active:
                 continue
@@ -730,10 +734,10 @@ class TPUPoaBatchEngine:
             # (measured: compacting tail rounds to 32 lanes saved
             # nothing and the extra compiled shapes cost ~5s), so idle
             # lanes in late rounds ride along for free
-            t0 = time.monotonic()
+            t0 = _mono()
             node_tape, seq_tape = self._dispatch(
                 bases, preds, nrows, sinks, seq_arr, slen)
-            self.phase_walls["dispatch"] += time.monotonic() - t0
+            self.phase_walls["dispatch"] += _mono() - t0
             self.n_rounds += 1
 
             def apply(i):
@@ -757,9 +761,9 @@ class TPUPoaBatchEngine:
                     q if q else b"\x00" * len(s), 1 if q else 0,
                     int(w.positions[li][0]))
 
-            t0 = time.monotonic()
+            t0 = _mono()
             _map(pool, apply, active)
-            self.phase_walls["apply"] += time.monotonic() - t0
+            self.phase_walls["apply"] += _mono() - t0
 
         # consensus extraction (pooled; the native call releases the GIL)
         results: List[Tuple[Optional[bytes], bool]] = [None] * n
@@ -789,9 +793,9 @@ class TPUPoaBatchEngine:
                 windows[i].warn_chimeric()
             results[i] = (out.raw[:length], True)
 
-        t0 = time.monotonic()
+        t0 = _mono()
         _map(pool, extract, range(n))
-        self.phase_walls["extract"] += time.monotonic() - t0
+        self.phase_walls["extract"] += _mono() - t0
         return results
 
     @staticmethod

@@ -90,6 +90,12 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from racon_tpu.obs import trace as obs_trace
+
+# the sanctioned clock (racon_tpu/obs): the watcher span feeds only
+# the trace and the device_s reporting counter, never control flow
+_mono = obs_trace.now
+
 _BIG = 1 << 28
 _N_SHIFT = 4          # pred band may lag <= 3 quanta of 128
 
@@ -1507,7 +1513,6 @@ def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
     devices (callers pad the batch; this pads further to a mesh-and-
     group multiple with inert 1-base windows)."""
     import threading
-    import time
 
     from racon_tpu.parallel.mesh_utils import interpret_mode
 
@@ -1521,7 +1526,7 @@ def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
     if b0 % mult:
         seqs, wts, meta, nlay, bblen = _pad_pairs(
             seqs, wts, meta, nlay, bblen, mult)
-    t_disp = time.monotonic()
+    t_disp = _mono()
     if n_dev > 1:
         cons, mout = _poa_full_sharded(
             jnp.asarray(seqs), jnp.asarray(wts), jnp.asarray(meta),
@@ -1560,7 +1565,11 @@ def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
     def _watch():
         try:
             jax.block_until_ready((cons, mout))
-            span["s"] = time.monotonic() - t_disp
+            t_end = _mono()
+            span["s"] = t_end - t_disp
+            obs_trace.TRACER.add_span(
+                "device.poa_megabatch", t_disp, t_end, cat="device",
+                lane="device", args={"b": int(b0)})
         except Exception:
             pass  # dispatch errors surface at collect()
 

@@ -27,6 +27,12 @@ import numpy as np
 from racon_tpu.core.overlap import Overlap
 from racon_tpu.core.polisher import Polisher, PolisherType
 from racon_tpu.core.window import WindowType
+from racon_tpu.obs import MetricAttr
+from racon_tpu.obs import trace as obs_trace
+
+# the one sanctioned clock (racon_tpu/obs; timestamps feed only the
+# trace/metrics/calibration records, never control flow)
+_now = obs_trace.now
 
 
 _PREWARM_THREADS: list = []
@@ -133,6 +139,22 @@ class TPUPolisher(Polisher):
     # HBM budget for one batch's packed direction tape (2 bits/cell)
     ALIGN_MEM_BUDGET = 2 << 30
     MAX_ALIGNMENTS_PER_BATCH = 1024
+
+    # registry-backed run metrics (racon_tpu/obs): these attributes
+    # READ/WRITE the per-run metrics registry (self.metrics), so the
+    # polisher's public counters, bench.py and the --metrics-json run
+    # report all share one store and can never disagree
+    align_cells = MetricAttr("align_cells")
+    poa_cells = MetricAttr("poa_cells")
+    poa_device_windows = MetricAttr("poa_device_windows")
+    poa_eligible_windows = MetricAttr("poa_eligible_windows")
+    poa_device_s = MetricAttr("poa_device_s")
+    align_device_s = MetricAttr("align_device_s")
+    align_wfa_device_s = MetricAttr("align_wfa_device_s")
+    align_band_device_s = MetricAttr("align_band_device_s")
+    pipeline_overlap_s = MetricAttr("pipeline_overlap_s")
+    poa_spec_used = MetricAttr("poa_spec_used")
+    poa_spec_wasted = MetricAttr("poa_spec_wasted")
 
     def __init__(self, sparser, oparser, tparser, type_: PolisherType,
                  window_length: int, quality_threshold: float,
@@ -399,9 +421,8 @@ class TPUPolisher(Polisher):
         self._align_device_free.set()
 
     def _note_poa_dispatch(self) -> None:
-        import time as _time
         if self._poa_first_dispatch_t is None:
-            self._poa_first_dispatch_t = _time.monotonic()
+            self._poa_first_dispatch_t = _now()
 
     def _poa_consumer_loop(self) -> None:
         """Speculative POA consumer: while the align stage drains,
@@ -421,12 +442,16 @@ class TPUPolisher(Polisher):
 
         def collect_one():
             idxs, coll = inflight.pop(0)
+            t0 = _now()
             try:
                 for i, r in zip(idxs, coll()):
                     self._spec_results[i] = r
             except Exception as exc:
                 with self._stream_lock:
                     self._stream_errors.append(exc)
+            obs_trace.TRACER.add_span(
+                "poa.spec_megabatch_collect", t0, _now(), cat="poa",
+                args={"n": len(idxs)})
 
         while True:
             stop = self._consumer_stop
@@ -445,6 +470,10 @@ class TPUPolisher(Polisher):
                     key=lambda i: -len(self.windows[i].sequences))
                 batch = [self.windows[i] for i in take]
                 self._note_poa_dispatch()
+                self.metrics.add("poa_spec_megabatches")
+                obs_trace.TRACER.add_instant(
+                    "poa.spec_megabatch_dispatch", cat="poa",
+                    args={"n": len(take)})
                 try:
                     coll = eng.consensus_batch_async(batch, self.trim,
                                                      pool=self._pool)
@@ -468,9 +497,7 @@ class TPUPolisher(Polisher):
         order stays canonical regardless of completion order), stop
         the consumer, and surface any error a pool-side decode
         swallowed."""
-        import time as _time
-
-        self._align_end_t = _time.monotonic()
+        self._align_end_t = _now()
         self._mark_align_device_free()
         led = self._ledger
         if led is not None and led.remaining():
@@ -505,12 +532,10 @@ class TPUPolisher(Polisher):
     def generate_consensuses(self) -> List[bool]:
         if self.tpu_poa_batches <= 0:
             return super().generate_consensuses()
-        import time
-        from jax.profiler import TraceAnnotation
-        t0 = time.monotonic()
-        with TraceAnnotation("racon_tpu.device_poa"):
+        t0 = _now()
+        with obs_trace.device_span("racon_tpu.device_poa"):
             flags = self._device_generate_consensuses()
-        end = time.monotonic()
+        end = _now()
         start = t0
         if self._poa_first_dispatch_t is not None:
             # the POA stage's span starts at its FIRST dispatch --
@@ -523,6 +548,7 @@ class TPUPolisher(Polisher):
                 self.pipeline_overlap_s = max(
                     0.0, self._align_end_t - self._poa_first_dispatch_t)
         self.stage_walls["device_poa"] = end - start
+        self.metrics.set("stage_wall_s.device_poa", end - start)
         return flags
 
     def _device_generate_consensuses(self) -> List[bool]:
@@ -544,6 +570,11 @@ class TPUPolisher(Polisher):
         # speculative results from the align-stage consumer (empty
         # when the pipeline is off or nothing became ready in time)
         self._join_consumer()
+        if self._ledger is not None:
+            # speculative backlog high-water (obs): how deep the
+            # ready queue got before the consumer drained it
+            self.metrics.peak("ledger_ready_high_water",
+                              self._ledger.ready_high_water)
         spec = self._spec_results
 
         # trivial windows (<3 sequences) keep the backbone and count as
@@ -578,7 +609,6 @@ class TPUPolisher(Polisher):
         #     faster when the engines' relative rates are unknown, at
         #     the price of run-to-run output variation.
         import threading
-        import time as _time
         from collections import deque
 
         from racon_tpu.utils import calibrate
@@ -698,11 +728,11 @@ class TPUPolisher(Polisher):
                     if len(work) <= (0 if steal else dev_left):
                         return
                     i = work.pop()
-                t1 = _time.monotonic()
+                t1 = _now()
                 flags[i] = self.windows[i].generate_consensus(
                     self.engine, self.trim)
                 with lock:
-                    meas["cpu_w"] += _time.monotonic() - t1
+                    meas["cpu_w"] += _now() - t1
                     meas["cpu_u"] += unit_of[i]
 
         workers = [self._pool.submit(cpu_worker)
@@ -720,15 +750,18 @@ class TPUPolisher(Polisher):
         from racon_tpu.tpu import align_pallas as _ap
         depth = _ap.pipeline_depth()
         pipe = deque()          # (idxs, collect_fn) FIFO
-        mark = _time.monotonic()
+        mark = _now()
 
         def apply(idxs, collect, record=True):
             nonlocal mark
             results = collect()
-            now = _time.monotonic()
+            now = _now()
             if record:
                 meas["dev"].append((now - mark,
                                     sum(unit_of[i] for i in idxs)))
+            obs_trace.TRACER.add_span(
+                "poa.megabatch", mark, now, cat="poa",
+                args={"n": len(idxs), "recorded": bool(record)})
             mark = now
             for i, (cons, ok) in zip(idxs, results):
                 if cons is None:
@@ -826,6 +859,17 @@ class TPUPolisher(Polisher):
         self.poa_reject_counts = dict(engine.reject_counts)
         self.poa_phase_walls = dict(engine.phase_walls)
         self.poa_rounds = engine.n_rounds
+        # mirror the engine's tallies into the run registry (the
+        # engine predates the registry and is shared by the
+        # speculative consumer, so it keeps its own lock-guarded
+        # counters; the registry is the reporting surface)
+        m = self.metrics
+        m.set("poa_rounds", engine.n_rounds)
+        for code, cnt in engine.reject_counts.items():
+            if cnt:
+                m.add(f"poa_reject.{code}", cnt)
+        for phase, wall in engine.phase_walls.items():
+            m.set(f"poa_phase_s.{phase}", round(wall, 6))
         return flags
 
     # ------------------------------------------------------------------
@@ -909,13 +953,13 @@ class TPUPolisher(Polisher):
             self._pipeline_begin(overlaps)
         try:
             if self.tpu_aligner_batches > 0:
-                import time
-                from jax.profiler import TraceAnnotation
                 self._prewarm_poa_async(overlaps)
-                t0 = time.monotonic()
-                with TraceAnnotation("racon_tpu.device_align"):
+                t0 = _now()
+                with obs_trace.device_span("racon_tpu.device_align"):
                     self._device_align_overlaps(overlaps)
-                self.stage_walls["device_align"] = time.monotonic() - t0
+                self.stage_walls["device_align"] = _now() - t0
+                self.metrics.set("stage_wall_s.device_align",
+                                 self.stage_walls["device_align"])
             else:
                 # no device align work: speculative POA megabatches
                 # may dispatch immediately and overlap the CPU align
@@ -1028,7 +1072,6 @@ class TPUPolisher(Polisher):
         affects the scan/POA hybrid loops (this path dispatches the
         whole device share at once, so there is nothing to steal)."""
         import threading
-        import time as _time
         from collections import deque
 
         from racon_tpu.ops import cpu as cpu_ops
@@ -1106,13 +1149,13 @@ class TPUPolisher(Polisher):
                         return
                     d, o = work.pop()
                     n_cpu_done += 1
-                t1 = _time.monotonic()
+                t1 = _now()
                 o.find_breaking_points(self.sequences,
                                        self.window_length,
                                        aligner=cpu_ops.align)
                 self._notify_overlap_done(o)
                 with lock:
-                    meas["cpu_w"] += _time.monotonic() - t1
+                    meas["cpu_w"] += _now() - t1
                     meas["cpu_u"] += cpu_cells(float(d))
 
         workers = [self._pool.submit(cpu_worker)
@@ -1288,8 +1331,6 @@ class TPUPolisher(Polisher):
            fall-through (the reference's
            exceeded_max_alignment_difference contract,
            src/cuda/cudaaligner.cpp:64-72)."""
-        import time as _time
-
         from racon_tpu.tpu import align_pallas, aligner
 
         queries = [o.query_span(self.sequences) for o in overlaps]
@@ -1379,8 +1420,10 @@ class TPUPolisher(Polisher):
                     [targets[i] for i in sub], bd, emax,
                     mesh=self.mesh)
 
-            tally = {"cert": 0, "mark": _time.monotonic()}
+            t_rung = _now()     # rung span start: chunk spans nest in
+            tally = {"cert": 0, "mark": t_rung}
             still = set()
+            self.metrics.add(f"align_rung_admit.wfa{emax}", len(idx))
 
             def consume(sub, coll, emax=emax, tally=tally,
                         still=still):
@@ -1389,11 +1432,14 @@ class TPUPolisher(Polisher):
                 self.align_device_s += dev_s
                 self.align_wfa_device_s += dev_s
                 steps = float(sum(min(int(d), emax) for d in dists))
+                now = _now()
+                obs_trace.TRACER.add_span(
+                    f"align.chunk.wfa{emax}", tally["mark"], now,
+                    cat="align", args={"n": len(sub)})
                 if hasattr(self, "_align_disp"):
-                    now = _time.monotonic()
                     self._align_disp.append(
                         ("wfa", emax, now - tally["mark"], steps))
-                    tally["mark"] = now
+                tally["mark"] = now
                 # e-steps actually run x diagonal extent = the honest
                 # cell count for a wavefront engine
                 self.align_cells += int(steps) * (2 * emax + 1)
@@ -1410,6 +1456,9 @@ class TPUPolisher(Polisher):
 
             align_pallas.run_pipelined(chunks, dispatch, consume,
                                        depth)
+            obs_trace.TRACER.add_span(
+                f"align.rung.wfa{emax}", t_rung, _now(), cat="align",
+                args={"n": len(idx), "chunks": len(chunks)})
             n_cert = tally["cert"]
             idx_set = set(idx)
             pending = [i for i in pending
@@ -1420,6 +1469,8 @@ class TPUPolisher(Polisher):
                 self.align_retry_counts[f"wfa{emax}"] = \
                     self.align_retry_counts.get(f"wfa{emax}", 0) \
                     + len(still)
+                self.metrics.add(f"align_rung_retry.wfa{emax}",
+                                 len(still))
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::align] wfa-aligned "
                 f"{n_cert}/{len(idx)} overlaps (emax {emax}"
@@ -1461,20 +1512,25 @@ class TPUPolisher(Polisher):
                     centers=[emp_knots(i) if i in use_emp else None
                              for i in sub])
 
-            tally = {"cert": 0, "mark": _time.monotonic()}
+            t_rung = _now()     # rung span start: chunk spans nest in
+            tally = {"cert": 0, "mark": t_rung}
             still = set()
+            self.metrics.add(f"align_rung_admit.band{wb}", len(idx))
 
             def consume(sub, coll, wb=wb, tally=tally, still=still):
                 moves, lens, dists = coll()
                 dev_s = getattr(coll, "device_s", lambda: 0.0)()
                 self.align_device_s += dev_s
                 self.align_band_device_s += dev_s
+                now = _now()
+                obs_trace.TRACER.add_span(
+                    f"align.chunk.band{wb}", tally["mark"], now,
+                    cat="align", args={"n": len(sub)})
                 if hasattr(self, "_align_disp"):
-                    now = _time.monotonic()
                     self._align_disp.append(
                         ("band", wb, now - tally["mark"],
                          float(sum(len(queries[i]) for i in sub))))
-                    tally["mark"] = now
+                tally["mark"] = now
                 self.align_cells += sum(len(queries[i])
                                         for i in sub) * wb
                 for k, i in enumerate(sub):
@@ -1498,6 +1554,9 @@ class TPUPolisher(Polisher):
 
             align_pallas.run_pipelined(chunks, dispatch, consume,
                                        depth)
+            obs_trace.TRACER.add_span(
+                f"align.rung.band{wb}", t_rung, _now(), cat="align",
+                args={"n": len(idx), "chunks": len(chunks)})
             n_cert = tally["cert"]
             idx_set = set(idx)
             pending = [i for i in pending
@@ -1513,6 +1572,12 @@ class TPUPolisher(Polisher):
             if wb != rungs[-1]:
                 self.align_retry_counts[wb] = \
                     self.align_retry_counts.get(wb, 0) + len(still)
+                if still:
+                    self.metrics.add(f"align_rung_retry.band{wb}",
+                                     len(still))
+            elif still:
+                self.metrics.add("align_rung_cpu_fallthrough",
+                                 len(still))
             tag = (f", {len(still)} "
                    + ("retries" if wb != rungs[-1] else "cpu")
                    if still else "")

@@ -51,6 +51,11 @@ def _log_contact(key_parts: tuple, outcome: str) -> None:
         if key_parts in _contact:
             return
         _contact[key_parts] = outcome
+    # process-wide first-contact counters (aot_shelf_hit/miss/fallback):
+    # shelf state is per process, not per polish, so these live in the
+    # GLOBAL registry and surface in the run report's "process" section
+    from racon_tpu.obs.metrics import REGISTRY
+    REGISTRY.add(f"aot_shelf_{outcome}")
     import sys
     print(f"[racon_tpu::aot_shelf] {outcome}: "
           f"{'/'.join(str(p) for p in key_parts)}", file=sys.stderr)

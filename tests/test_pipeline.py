@@ -103,6 +103,45 @@ def test_pipeline_timing_jitter_cannot_move_bytes(dataset,
         "jittered pipeline is not run-to-run deterministic")
 
 
+def test_tracing_enabled_cannot_move_bytes(dataset, staged_bytes,
+                                           tmp_path):
+    """Tracing enabled (RACON_TPU_TRACE) + pipeline on must still
+    equal the staged, tracing-off bytes: obs clocks feed only the
+    trace, never control flow — and the recorded trace must be a
+    loadable Chrome trace covering both device stages."""
+    import json
+
+    from racon_tpu.obs import trace as obs_trace
+
+    trace_path = str(tmp_path / "pipeline_trace.json")
+    obs_trace.TRACER.clear()
+    out, _ = _polish_bytes(dataset, {
+        "RACON_TPU_PIPELINE": "1",
+        "RACON_TPU_TRACE": trace_path,
+    })
+    assert out == staged_bytes, (
+        "tracing-enabled pipeline diverged from the tracing-off "
+        "staged output")
+    doc = json.load(open(obs_trace.write_trace(trace_path)))
+    names = {ev["name"] for ev in doc["traceEvents"]
+             if ev.get("ph") == "X"}
+    assert "racon_tpu.device_align" in names
+    assert "racon_tpu.device_poa" in names
+    obs_trace.TRACER.clear()
+
+
+def test_window_ledger_ready_high_water():
+    led = WindowLedger(4)
+    led.seal()
+    led.push_ready([0, 1, 2])
+    led.pop_ready(8, min_n=1)
+    led.push_ready([3])
+    # high-water tracks the deepest the queue ever got, not its
+    # current depth
+    assert led.ready_high_water == 3
+    assert led.n_ready() == 1
+
+
 def test_window_ledger_order_independent():
     led = WindowLedger(5)
     # overlap A (ordinal 0) covers windows 0..2; B (ordinal 1)
