@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Serving-lane tier-1 (ISSUE 5 CI satellite): boots the polishing
+# daemon under the CPU backend and runs the serve e2e suite —
+# byte-identity vs the one-shot CLI, two-job concurrency, queue-full
+# backpressure, SIGTERM drain, warm-start zero-compile assertion —
+# with the same hardening as the pipeline lane:
+#   * JAX_PLATFORMS=cpu + 8 virtual devices (tests/conftest.py)
+#     exercises the sharded dispatch path without hardware;
+#   * PYTHONDEVMODE=1 surfaces unclosed sockets/files and unjoined
+#     threads in the server's connection handlers and job sessions;
+#   * pytest's faulthandler timeout dumps EVERY thread's traceback
+#     if a test hangs, so a deadlocked scheduler/drain shows up as a
+#     stack dump naming the blocked lock instead of an opaque CI
+#     timeout (the daemon subprocesses dump via SIGKILL-on-timeout
+#     in the tests' own _start_server deadline).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export PYTHONDEVMODE=1
+python -m pytest tests/test_serve.py -q \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"

@@ -26,6 +26,16 @@ from racon_tpu.io.parsers import (MalformedInputError,
                                   UnsupportedFormatError)
 
 USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequences>
+       racon-tpu serve --socket PATH [options ...]
+       racon-tpu submit --socket PATH [options ...] <sequences> <overlaps> <target sequences>
+       racon-tpu status --socket PATH
+
+    subcommands (racon_tpu/serve — persistent polishing service):
+        serve    start the warm-kernel job daemon on a unix socket
+        submit   run one polish through a daemon (same options and
+                 stdout contract as the one-shot form)
+        status   print a daemon's queue/registry/provenance snapshot
+
 
     #default output is stdout
     <sequences>
@@ -203,6 +213,20 @@ def _log_run_summary(polisher, opts) -> None:
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
+    # serving subcommands dispatch before option parsing: they own
+    # their own argv shape (and the serve daemon must NOT inherit
+    # the one-shot assumptions below — racing prewarm thread,
+    # os._exit — it prewarms once, synchronously, and exits only
+    # after a graceful drain)
+    if argv and argv[0] == "serve":
+        from racon_tpu.serve import server as serve_server
+        raise SystemExit(serve_server.main(argv[1:]))
+    if argv and argv[0] == "submit":
+        from racon_tpu.serve import client as serve_client
+        raise SystemExit(serve_client.main_submit(argv[1:]))
+    if argv and argv[0] == "status":
+        from racon_tpu.serve import client as serve_client
+        raise SystemExit(serve_client.main_status(argv[1:]))
     try:
         opts, inputs = parse_args(argv)
     except ValueError as exc:

@@ -488,3 +488,16 @@ class Polisher:
 
     def total_log(self) -> None:
         self.logger.total("[racon_tpu::Polisher::] total =")
+
+    def close(self) -> None:
+        """Release per-run resources (the worker pool).  The one-shot
+        CLI never needs this (``os._exit`` reaps everything), but a
+        long-lived process running many polishes — bench.py, the
+        serve daemon — would otherwise leak one thread pool (and
+        three parser file handles) per job
+        (racon_tpu/serve/session.py calls this per job)."""
+        self._pool.shutdown(wait=True)
+        for parser in (self.sparser, self.oparser, self.tparser):
+            close = getattr(parser, "close", None)
+            if close is not None:
+                close()

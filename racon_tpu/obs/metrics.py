@@ -53,6 +53,15 @@ class Registry:
         if self.parent is not None:
             self.parent.set(name, value)
 
+    def set_local(self, name: str, value) -> None:
+        """Gauge write WITHOUT parent propagation: for per-run views
+        of inherently process-wide counters (the serve path's
+        per-job AOT-shelf deltas, racon_tpu/serve/session.py) —
+        propagating a job-local delta would corrupt the process
+        total it was derived from."""
+        with self._lock:
+            self._gauges[name] = value
+
     def peak(self, name: str, value) -> None:
         with self._lock:
             if value > self._gauges.get(name, value - 1):

@@ -50,6 +50,15 @@ KNOWN_KNOBS = {
     "RACON_TPU_CACHE_DIR": "",
     "RACON_TPU_TRACE": "",
     "RACON_TPU_METRICS_JSON": "",
+    # serving (racon_tpu/serve): queue bound, worker count, idle
+    # self-shutdown, admission wall cap, calibration store freeze
+    "RACON_TPU_SERVE_QUEUE": "8",
+    "RACON_TPU_SERVE_JOBS": "2",
+    "RACON_TPU_SERVE_IDLE_S": "0",
+    "RACON_TPU_SERVE_MAX_WALL_S": "",
+    "RACON_TPU_SERVE_ALIGN_MBPS": "",
+    "RACON_TPU_SERVE_POA_MBPS": "",
+    "RACON_TPU_CALIB_FREEZE": "",
 }
 
 # host-capability probe reference wall (bench.py's budget scaling):
@@ -143,9 +152,11 @@ def environment(probe: bool = True) -> dict:
     return env
 
 
-def write_metrics_json(path: str, run_registry=None, details=None,
-                       probe: bool = True) -> str:
-    """Write the run report (atomic replace).  Returns ``path``."""
+def metrics_doc(run_registry=None, details=None,
+                probe: bool = True) -> dict:
+    """The run report as a dict — what ``--metrics-json`` writes and
+    what a served job embeds in its response frame
+    (racon_tpu/serve/session.py)."""
     from racon_tpu.obs.metrics import REGISTRY
 
     doc = {
@@ -157,6 +168,14 @@ def write_metrics_json(path: str, run_registry=None, details=None,
     }
     if details:
         doc["details"] = details
+    return doc
+
+
+def write_metrics_json(path: str, run_registry=None, details=None,
+                       probe: bool = True) -> str:
+    """Write the run report (atomic replace).  Returns ``path``."""
+    doc = metrics_doc(run_registry=run_registry, details=details,
+                      probe=probe)
     tmp = path + f".tmp{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1)

@@ -1,0 +1,38 @@
+"""Persistent polishing service (warm-kernel job server + client).
+
+One-shot ``racon-tpu`` pays the full process setup on every run: the
+jax import, the AOT-shelf loads, kernel tracing/compiles and the
+calibration read all happen again, then ``os._exit`` throws the warm
+state away.  The reference amortizes device setup across one run via
+per-GPU batch queues (src/cuda/cudabatch.cpp); this package amortizes
+it across RUNS — the warm-weights/request-queue shape of an inference
+server, applied to polishing:
+
+* :mod:`racon_tpu.serve.server` — a long-lived daemon on a
+  unix-domain socket (``racon-tpu serve --socket PATH``).  It prewarms
+  the AOT shelf once at startup and keeps the process-wide warm state
+  (jit caches, shelved exports, calibration) resident, so job N>=2
+  pays zero compile/prewarm cost.
+* :mod:`racon_tpu.serve.scheduler` — a bounded priority queue with
+  admission control priced by :func:`racon_tpu.utils.calibrate.
+  predict_walls`, structured backpressure rejects, and a worker pool
+  that runs up to ``RACON_TPU_SERVE_JOBS`` polishes concurrently;
+  their megabatches interleave through the shared device FIFO.
+* :mod:`racon_tpu.serve.session` — one job's execution: a fresh
+  polisher wired to a per-job child metrics registry, per-job
+  namespaced AOT-shelf counters, and a ``--metrics-json``-style
+  report embedded in the response.
+* :mod:`racon_tpu.serve.client` — the blocking client and the
+  ``racon-tpu submit`` / ``racon-tpu status`` subcommands.
+
+Determinism contract: a served job's FASTA is byte-identical to a
+standalone CLI run with the same inputs/flags/threads/devices — the
+server freezes calibration stores at startup (``RACON_TPU_CALIB_
+FREEZE``) so job N's measured rates can never steer job N+1's split,
+and each job gets its own polisher whose engine assignment stays a
+pure function of its input (pinned by tests/test_serve.py, including
+with two jobs in flight concurrently).
+"""
+
+from racon_tpu.serve.protocol import (ProtocolError, recv_frame,  # noqa: F401
+                                      send_frame)

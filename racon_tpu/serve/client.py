@@ -1,0 +1,185 @@
+"""Blocking client + the ``racon-tpu submit`` / ``status`` CLIs.
+
+``racon-tpu submit --socket PATH [options ...] <sequences>
+<overlaps> <target sequences>`` takes the SAME positional inputs and
+option set as the one-shot CLI (the option string is parsed by the
+same ``cli.parse_args``), ships them as a job spec to a running
+``racon-tpu serve`` daemon, blocks until the job finishes, and
+writes the polished FASTA to stdout — byte-identical to what the
+one-shot CLI would have printed, minus the cold start.
+
+Exit codes: 0 on success; 1 on a failed job or transport error; 75
+(EX_TEMPFAIL) on a backpressure/draining reject — retryable by
+contract, so batch drivers can distinguish "try again" from
+"broken".  The structured reject reason is printed to stderr as one
+JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+
+from racon_tpu.serve import protocol
+
+EX_TEMPFAIL = 75
+#: reject codes a caller may retry verbatim later
+RETRYABLE = ("queue_full", "draining")
+
+
+class ServeError(RuntimeError):
+    """Transport-level failure (no server, protocol violation)."""
+
+
+def request(socket_path: str, frame: dict, timeout: float = None):
+    """One request/response round trip.  ``timeout`` bounds every
+    socket operation; submits block for the whole job, so the
+    default is no timeout."""
+    sock = socket.socket(socket.AF_UNIX)
+    sock.settimeout(timeout)
+    try:
+        try:
+            sock.connect(socket_path)
+        except OSError as exc:
+            raise ServeError(
+                f"cannot reach server at {socket_path} ({exc})"
+            ) from exc
+        try:
+            protocol.send_frame(sock, frame)
+            resp = protocol.recv_frame(sock)
+        except (OSError, protocol.ProtocolError) as exc:
+            raise ServeError(f"transport failure ({exc})") from exc
+        if resp is None:
+            raise ServeError("server closed the connection without "
+                             "a response")
+        return resp
+    finally:
+        sock.close()
+
+
+def submit(socket_path: str, spec: dict, priority: int = 0,
+           timeout: float = None) -> dict:
+    """Submit one job and block until it completes (or is rejected).
+    Returns the raw response frame; callers check ``resp["ok"]``."""
+    return request(socket_path,
+                   {"op": "submit", "job": spec,
+                    "priority": priority}, timeout=timeout)
+
+
+def status(socket_path: str, timeout: float = 30.0) -> dict:
+    return request(socket_path, {"op": "status"}, timeout=timeout)
+
+
+def admin(socket_path: str, op: str, timeout: float = 30.0) -> dict:
+    """pause / resume / shutdown."""
+    return request(socket_path, {"op": op}, timeout=timeout)
+
+
+def spec_from_opts(opts: dict, inputs) -> dict:
+    """One-shot CLI options -> job spec (racon_tpu/serve/session.py
+    resolves omitted keys to the same CLI defaults)."""
+    return {
+        "sequences": os.path.abspath(inputs[0]),
+        "overlaps": os.path.abspath(inputs[1]),
+        "targets": os.path.abspath(inputs[2]),
+        "type": opts["type"].name,
+        "window_length": opts["window_length"],
+        "quality_threshold": opts["quality_threshold"],
+        "error_threshold": opts["error_threshold"],
+        "trim": opts["trim"],
+        "match": opts["match"],
+        "mismatch": opts["mismatch"],
+        "gap": opts["gap"],
+        "threads": opts["threads"],
+        "drop_unpolished": opts["drop_unpolished"],
+        "tpu_poa_batches": opts["tpu_poa_batches"],
+        "tpu_banded_alignment": opts["tpu_banded_alignment"],
+        "tpu_aligner_batches": opts["tpu_aligner_batches"],
+    }
+
+
+def _split_serve_flags(argv):
+    """Pull --socket/--priority out of the argv so the rest parses
+    with the unchanged one-shot ``cli.parse_args``."""
+    socket_path, priority = None, 0
+    rest = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--socket":
+            i += 1
+            socket_path = argv[i] if i < len(argv) else None
+        elif a.startswith("--socket="):
+            socket_path = a.split("=", 1)[1]
+        elif a == "--priority":
+            i += 1
+            priority = int(argv[i]) if i < len(argv) else 0
+        elif a.startswith("--priority="):
+            priority = int(a.split("=", 1)[1])
+        else:
+            rest.append(a)
+        i += 1
+    return socket_path, priority, rest
+
+
+def main_submit(argv) -> int:
+    from racon_tpu import cli
+
+    socket_path, priority, rest = _split_serve_flags(argv)
+    if not socket_path:
+        print("[racon_tpu::submit] error: --socket PATH is required!",
+              file=sys.stderr)
+        return 1
+    opts, inputs = cli.parse_args(rest)
+    if len(inputs) < 3:
+        print("[racon_tpu::submit] error: missing input file(s)!",
+              file=sys.stderr)
+        return 1
+    try:
+        resp = submit(socket_path, spec_from_opts(opts, inputs),
+                      priority=priority)
+    except ServeError as exc:
+        print(f"[racon_tpu::submit] error: {exc}", file=sys.stderr)
+        return 1
+    if not resp.get("ok"):
+        err = resp.get("error", {})
+        print(json.dumps(err), file=sys.stderr)
+        code = err.get("code")
+        print(f"[racon_tpu::submit] error: job rejected/failed "
+              f"({code}): {err.get('reason')}", file=sys.stderr)
+        return EX_TEMPFAIL if code in RETRYABLE else 1
+
+    import base64
+    out = sys.stdout.buffer
+    out.write(base64.b64decode(resp["fasta_b64"]))
+    sys.stdout.flush()
+    out.flush()
+    if opts["metrics_json"]:
+        tmp = opts["metrics_json"] + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(resp["report"], f, indent=1)
+        os.replace(tmp, opts["metrics_json"])
+        print(f"[racon_tpu::submit] metrics report written to "
+              f"{opts['metrics_json']}", file=sys.stderr)
+    print(f"[racon_tpu::submit] job {resp['job_id']} done in "
+          f"{resp['wall_s']:.2f} s "
+          f"({resp['n_sequences']} sequence(s))", file=sys.stderr)
+    return 0
+
+
+def main_status(argv) -> int:
+    socket_path, _, rest = _split_serve_flags(argv)
+    if not socket_path or rest:
+        print("usage: racon-tpu status --socket PATH",
+              file=sys.stderr)
+        return 1
+    try:
+        doc = status(socket_path)
+    except ServeError as exc:
+        print(f"[racon_tpu::status] error: {exc}", file=sys.stderr)
+        return 1
+    json.dump(doc, sys.stdout, indent=1)
+    print()
+    return 0

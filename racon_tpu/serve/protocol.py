@@ -1,0 +1,89 @@
+"""Wire protocol: length-prefixed JSON frames over a unix socket.
+
+Every message in both directions is one frame::
+
+    +----------------+----------------------+
+    | 4 bytes, u32BE |  <length> JSON bytes |
+    +----------------+----------------------+
+
+The JSON document is always an object.  Client requests carry an
+``op`` key (``submit`` / ``status`` / ``pause`` / ``resume`` /
+``shutdown``); server responses carry ``ok`` (bool) and, when
+``ok`` is false, a machine-readable ``error`` object::
+
+    {"ok": false,
+     "error": {"code": "queue_full", "reason": "...", ...}}
+
+Error codes in use: ``queue_full`` (backpressure — the bounded queue
+is at capacity), ``draining`` (SIGTERM/shutdown received; running
+jobs finish, new ones are rejected), ``job_too_large`` (admission
+control: the priced wall exceeds ``RACON_TPU_SERVE_MAX_WALL_S``),
+``input_not_found`` / ``bad_request`` (malformed submission), and
+``job_failed`` (the polish itself raised; the queue and the warm
+engines survive).
+
+Polished FASTA rides inside the JSON response base64-encoded
+(``fasta_b64``) so the framing stays single-format; the client
+decodes back to the exact bytes the polisher emitted.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+#: refuse frames past this size (a corrupt length prefix must not
+#: make the server try to allocate gigabytes)
+FRAME_MAX = 1 << 30
+
+_LEN = struct.Struct(">I")
+
+
+class ProtocolError(RuntimeError):
+    """Malformed frame (bad length prefix, truncated body, non-JSON
+    payload).  The server answers one ``bad_request`` frame when it
+    can and drops the connection; the warm state is untouched."""
+
+
+def send_frame(sock, obj: dict) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    if len(payload) > FRAME_MAX:
+        raise ProtocolError(f"frame too large ({len(payload)} bytes)")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ProtocolError(
+                f"connection closed mid-frame ({len(buf)}/{n} bytes)")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock):
+    """Read one frame; returns the decoded object, or ``None`` on a
+    clean EOF at a frame boundary (peer closed)."""
+    head = b""
+    while len(head) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(head))
+        if not chunk:
+            if head:
+                raise ProtocolError("connection closed mid-prefix")
+            return None
+        head += chunk
+    (n,) = _LEN.unpack(head)
+    if n > FRAME_MAX:
+        raise ProtocolError(f"frame length {n} exceeds FRAME_MAX")
+    try:
+        return json.loads(_recv_exact(sock, n))
+    except ValueError as exc:
+        raise ProtocolError(f"frame body is not JSON ({exc})") from exc
+
+
+def error_frame(code: str, reason: str, **extra) -> dict:
+    err = {"code": code, "reason": reason}
+    err.update(extra)
+    return {"ok": False, "error": err}

@@ -1,0 +1,274 @@
+"""Bounded priority job queue with priced admission control.
+
+The device-side analog of the reference's per-GPU batch queues
+(src/cuda/cudapolisher.cpp:257-336), lifted one level: instead of
+windows queuing for one run's batches, whole polish JOBS queue for
+the process's warm engines.
+
+* **Admission control** — every submission is priced before it
+  enters the queue: input file sizes feed a bytes-proportional
+  align/POA wall model whose combination comes from
+  :func:`racon_tpu.utils.calibrate.predict_walls` (the r8 overlapped
+  budget model), and ``RACON_TPU_SERVE_MAX_WALL_S`` (unset = no cap)
+  rejects jobs whose predicted wall exceeds the cap with a
+  ``job_too_large`` error carrying the estimate.
+* **Backpressure** — the queue is bounded (``RACON_TPU_SERVE_QUEUE``,
+  default 8 pending jobs).  A submission past the bound is rejected
+  immediately with a machine-readable ``queue_full`` error (depth +
+  bound included) instead of blocking the connection: the caller —
+  e.g. a fleet scheduler — decides whether to retry, reroute or shed.
+* **Multi-job scheduling** — ``RACON_TPU_SERVE_JOBS`` worker threads
+  (default 2) pop jobs in (priority desc, FIFO) order and run them
+  concurrently; their megabatch dispatches interleave through the
+  shared device FIFO (JAX serializes the actual device queue), so a
+  small job is not stuck behind a large one's CPU-side tail.  Output
+  bytes stay per-job deterministic: each job owns its polisher, and
+  engine assignment inside a polisher is a pure function of that
+  job's input (see racon_tpu/serve/__init__.py).
+* **Lifecycle** — ``pause()``/``resume()`` gate the workers without
+  touching running jobs (maintenance windows; also what makes the
+  backpressure/drain tests timing-independent); ``drain()`` stops
+  admission (``draining`` rejects), lets queued+running jobs finish,
+  and returns.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import os
+import threading
+from typing import Callable, Optional
+
+from racon_tpu.obs import REGISTRY
+from racon_tpu.obs import trace as obs_trace
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+# bytes-per-second priors for the admission price: deliberately crude
+# (admission only needs the right order of magnitude to shed a
+# monster job) and deliberately NOT the in-run calibrated rates --
+# admission prices from file sizes before anything is parsed, and a
+# pure-stat model keeps the accept/reject decision a function of the
+# submission alone.  RACON_TPU_SERVE_{ALIGN,POA}_MBPS override.
+_ALIGN_MB_PER_S = 4.0
+_POA_MB_PER_S = 2.0
+
+
+def estimate_job(spec: dict) -> dict:
+    """Price a submission from input stats alone.
+
+    Returns the :func:`calibrate.predict_walls` dict (additive wall,
+    overlapped floor, predicted wall) plus the raw inputs that
+    produced it, so a reject is auditable from the response."""
+    from racon_tpu.utils import calibrate
+
+    sizes = {}
+    for key in ("sequences", "overlaps", "targets"):
+        sizes[key] = os.stat(spec[key]).st_size
+    align_mbps = float(os.environ.get("RACON_TPU_SERVE_ALIGN_MBPS",
+                                      _ALIGN_MB_PER_S))
+    poa_mbps = float(os.environ.get("RACON_TPU_SERVE_POA_MBPS",
+                                    _POA_MB_PER_S))
+    mb = 1024.0 * 1024.0
+    # align work scales with the read+overlap volume, POA with the
+    # read volume layered over the targets
+    align_s = (sizes["sequences"] + sizes["overlaps"]) / mb / align_mbps
+    poa_s = (sizes["sequences"] + sizes["targets"]) / mb / poa_mbps
+    est = calibrate.predict_walls(align_s, poa_s,
+                                  overlap_s=min(align_s, poa_s))
+    est["input_bytes"] = sizes
+    return est
+
+
+class Job:
+    """One queued submission: spec + completion rendezvous."""
+
+    def __init__(self, job_id: int, spec: dict, priority: int,
+                 estimate: dict):
+        self.id = job_id
+        self.spec = spec
+        self.priority = priority
+        self.estimate = estimate
+        self.done = threading.Event()
+        self.result: Optional[dict] = None   # set exactly once
+
+    def finish(self, result: dict) -> None:
+        self.result = result
+        self.done.set()
+
+
+class RejectError(Exception):
+    """Admission refusal; ``.error`` is the machine-readable dict."""
+
+    def __init__(self, error: dict):
+        super().__init__(error.get("reason", error.get("code")))
+        self.error = error
+
+
+class JobScheduler:
+    def __init__(self, runner: Callable[[Job], dict],
+                 max_queue: int = None, max_jobs: int = None):
+        self._runner = runner
+        self.max_queue = (max_queue if max_queue is not None
+                          else _env_int("RACON_TPU_SERVE_QUEUE", 8))
+        self.max_jobs = max(1, max_jobs if max_jobs is not None
+                            else _env_int("RACON_TPU_SERVE_JOBS", 2))
+        self._cond = threading.Condition()
+        self._heap: list = []            # (-priority, seq, Job)
+        self._seq = itertools.count()
+        self._ids = itertools.count(1)
+        self._running: dict = {}         # job_id -> Job
+        self._paused = False
+        self._draining = False
+        self._stopped = False
+        self._completed = 0
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"racon-serve-worker-{i}")
+            for i in range(self.max_jobs)]
+        for t in self._workers:
+            t.start()
+
+    # -- admission -----------------------------------------------------
+
+    def submit(self, spec: dict, priority: int = 0) -> Job:
+        """Admit a job or raise :class:`RejectError`.  Never blocks on
+        queue capacity — backpressure is an immediate structured
+        reject, so a full server answers in microseconds."""
+        for key in ("sequences", "overlaps", "targets"):
+            path = spec.get(key)
+            if not isinstance(path, str):
+                raise RejectError({"code": "bad_request",
+                                   "reason": f"missing input '{key}'"})
+            if not os.path.isfile(path):
+                raise RejectError({
+                    "code": "input_not_found",
+                    "reason": f"{key} file not found on the server "
+                              f"host: {path}"})
+        estimate = estimate_job(spec)
+        cap = os.environ.get("RACON_TPU_SERVE_MAX_WALL_S")
+        if cap and estimate["predicted_wall_s"] > float(cap):
+            REGISTRY.add("serve_reject.job_too_large")
+            raise RejectError({
+                "code": "job_too_large",
+                "reason": f"predicted wall "
+                          f"{estimate['predicted_wall_s']:.1f}s exceeds "
+                          f"RACON_TPU_SERVE_MAX_WALL_S={cap}",
+                "estimate": estimate})
+        with self._cond:
+            if self._draining:
+                REGISTRY.add("serve_reject.draining")
+                raise RejectError({
+                    "code": "draining",
+                    "reason": "server is draining: running jobs "
+                              "finish, new jobs are rejected"})
+            if len(self._heap) >= self.max_queue:
+                REGISTRY.add("serve_reject.queue_full")
+                raise RejectError({
+                    "code": "queue_full",
+                    "reason": "job queue is at capacity; retry later",
+                    "queue_depth": len(self._heap),
+                    "max_queue": self.max_queue,
+                    "running": len(self._running)})
+            job = Job(next(self._ids), spec, priority, estimate)
+            heapq.heappush(self._heap, (-priority, next(self._seq),
+                                        job))
+            REGISTRY.add("serve_jobs_submitted")
+            REGISTRY.peak("serve_queue_high_water", len(self._heap))
+            obs_trace.TRACER.add_instant(
+                "serve.submit", cat="serve",
+                args={"job": job.id, "priority": priority,
+                      "queue_depth": len(self._heap)})
+            self._cond.notify()
+            return job
+
+    # -- workers -------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stopped and (
+                        self._paused or not self._heap):
+                    self._cond.wait(0.5)
+                if self._stopped:
+                    return
+                _, _, job = heapq.heappop(self._heap)
+                self._running[job.id] = job
+            try:
+                result = self._runner(job)
+            except Exception as exc:   # runner bug: job fails, server
+                result = {              # and queue survive
+                    "ok": False,
+                    "error": {"code": "job_failed",
+                              "type": type(exc).__name__,
+                              "reason": str(exc)}}
+            with self._cond:
+                del self._running[job.id]
+                self._completed += 1
+                self._cond.notify_all()
+            job.finish(result)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def pause(self) -> None:
+        """Stop popping queued jobs (running ones continue) — a
+        maintenance gate; admission stays open."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def start_drain(self) -> None:
+        """Flip to draining: new submissions reject, queued + running
+        jobs keep going.  A paused queue resumes — admitted jobs were
+        promised execution."""
+        with self._cond:
+            self._draining = True
+            self._paused = False
+            self._cond.notify_all()
+
+    def wait_drained(self, timeout: float = None) -> bool:
+        """Block until every admitted job finished, then stop the
+        workers.  Returns True when everything finished in time."""
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: not self._heap and not self._running, timeout)
+            self._stopped = True
+            self._cond.notify_all()
+        return ok
+
+    def drain(self, timeout: float = None) -> bool:
+        """Reject new jobs, finish queued + running ones."""
+        self.start_drain()
+        return self.wait_drained(timeout)
+
+    @property
+    def draining(self) -> bool:
+        with self._cond:
+            return self._draining
+
+    def idle(self) -> bool:
+        with self._cond:
+            return not self._heap and not self._running
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            return {
+                "queue_depth": len(self._heap),
+                "max_queue": self.max_queue,
+                "running": sorted(self._running),
+                "max_jobs": self.max_jobs,
+                "completed": self._completed,
+                "paused": self._paused,
+                "draining": self._draining,
+            }

@@ -1,0 +1,294 @@
+"""The polishing daemon: warm kernels behind a unix-domain socket.
+
+``racon-tpu serve --socket PATH`` starts a long-lived worker that
+
+* prewarms the AOT shelf ONCE at startup
+  (:func:`racon_tpu.tpu.polisher.prewarm_once`) and keeps every
+  piece of process-wide warm state resident between jobs: the jax
+  import, the in-process jit caches, the deserialized shelf exports
+  and the calibration rates — so job N>=2 pays zero compile/prewarm
+  cost (the warm-start assertion tests/test_serve.py pins);
+* freezes calibration stores (``RACON_TPU_CALIB_FREEZE=1``): a
+  served job's bytes must match a standalone CLI run, and letting
+  job N's measured rates steer job N+1's split would break that for
+  any job order a standalone run never saw;
+* accepts length-prefixed JSON frames (racon_tpu/serve/protocol.py)
+  on the socket — one request per connection for ``submit`` (the
+  connection blocks until the job finishes; that is the client's
+  rendezvous), ``status`` / ``pause`` / ``resume`` / ``shutdown``
+  answer immediately;
+* drains gracefully on SIGTERM/SIGINT or a ``shutdown`` op: running
+  AND queued jobs finish, new submissions get a structured
+  ``draining`` reject, then the process exits 0;
+* self-shuts down after ``RACON_TPU_SERVE_IDLE_S`` seconds (0 =
+  never, the default) with no queued/running job and no connection —
+  a fleet manager can spawn servers per dataset burst and let them
+  reap themselves.
+
+Crash containment: a malformed frame answers ``bad_request`` and
+drops only that connection; a failing job answers ``job_failed`` on
+its own connection; neither touches the queue or the warm engines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import sys
+import threading
+
+from racon_tpu.obs import REGISTRY
+from racon_tpu.obs import trace as obs_trace
+from racon_tpu.serve import protocol
+from racon_tpu.serve.scheduler import JobScheduler, RejectError
+from racon_tpu.serve.session import run_job
+
+
+def eprint(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+class PolishServer:
+    def __init__(self, socket_path: str, max_queue: int = None,
+                 max_jobs: int = None, idle_timeout: float = None):
+        self.socket_path = socket_path
+        self.idle_timeout = (
+            idle_timeout if idle_timeout is not None
+            else float(os.environ.get("RACON_TPU_SERVE_IDLE_S", "0")))
+        self.scheduler = JobScheduler(run_job, max_queue=max_queue,
+                                      max_jobs=max_jobs)
+        self._sock = None
+        self._stop = threading.Event()
+        self._handlers: list = []
+        self._last_activity = obs_trace.now()
+        self._lock = threading.Lock()
+
+    # -- warm state ----------------------------------------------------
+
+    def prewarm(self, match: int, mismatch: int, gap: int,
+                trim: bool) -> None:
+        """Populate the AOT shelf / jit caches before the first job.
+        Synchronous and idempotent: the daemon has no input parse to
+        hide the work behind (unlike the one-shot CLI's racing
+        prewarm thread), and a server that answers its first submit
+        only after the shelf is warm gives every job — including the
+        first — the same latency contract."""
+        from racon_tpu.tpu.polisher import prewarm_once
+
+        with obs_trace.span("serve.prewarm", cat="serve"):
+            ran = prewarm_once(match, mismatch, gap, trim)
+        if ran:
+            eprint("[racon_tpu::serve] AOT shelf prewarmed")
+
+    # -- request handling ----------------------------------------------
+
+    def _handle_submit(self, req: dict) -> dict:
+        spec = req.get("job")
+        if not isinstance(spec, dict):
+            return protocol.error_frame("bad_request",
+                                        "submit carries no job object")
+        try:
+            job = self.scheduler.submit(
+                spec, priority=int(req.get("priority", 0)))
+        except RejectError as exc:
+            return {"ok": False, "error": exc.error}
+        job.done.wait()
+        self._touch()
+        return job.result
+
+    def _status_doc(self) -> dict:
+        from racon_tpu.obs import provenance
+
+        return {
+            "ok": True,
+            "pid": os.getpid(),
+            "socket": self.socket_path,
+            "queue": self.scheduler.snapshot(),
+            "idle_timeout_s": self.idle_timeout,
+            "registry": REGISTRY.snapshot(),
+            "provenance": provenance.environment(probe=False),
+        }
+
+    def _serve_connection(self, conn) -> None:
+        try:
+            req = protocol.recv_frame(conn)
+            if req is None:
+                return
+            op = req.get("op") if isinstance(req, dict) else None
+            if op == "submit":
+                resp = self._handle_submit(req)
+            elif op == "status":
+                resp = self._status_doc()
+            elif op == "pause":
+                self.scheduler.pause()
+                resp = {"ok": True, "paused": True}
+            elif op == "resume":
+                self.scheduler.resume()
+                resp = {"ok": True, "paused": False}
+            elif op == "shutdown":
+                resp = {"ok": True, "draining": True}
+                self._stop.set()
+            else:
+                resp = protocol.error_frame("bad_request",
+                                            f"unknown op {op!r}")
+            protocol.send_frame(conn, resp)
+        except protocol.ProtocolError as exc:
+            REGISTRY.add("serve_bad_frames")
+            try:
+                protocol.send_frame(
+                    conn, protocol.error_frame("bad_request", str(exc)))
+            except OSError:
+                pass
+        except OSError:
+            pass   # client went away mid-reply; nothing to salvage
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _touch(self) -> None:
+        with self._lock:
+            self._last_activity = obs_trace.now()
+
+    def _idle_expired(self) -> bool:
+        if self.idle_timeout <= 0:
+            return False
+        if not self.scheduler.idle():
+            return False
+        with self._lock:
+            return (obs_trace.now() - self._last_activity
+                    > self.idle_timeout)
+
+    # -- main loop -----------------------------------------------------
+
+    def serve_forever(self) -> int:
+        # a served job's split must be a pure function of the
+        # server-start calibration state (see module docstring)
+        os.environ["RACON_TPU_CALIB_FREEZE"] = "1"
+        if os.path.exists(self.socket_path):
+            # a stale socket from a dead server blocks bind();
+            # a LIVE server answers a probe connect, and replacing
+            # it would silently orphan its queue
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(self.socket_path)
+            except OSError:
+                os.unlink(self.socket_path)
+            else:
+                eprint(f"[racon_tpu::serve] error: a live server "
+                       f"already owns {self.socket_path}")
+                return 1
+            finally:
+                probe.close()
+        self._sock = socket.socket(socket.AF_UNIX)
+        self._sock.bind(self.socket_path)
+        self._sock.listen(16)
+        self._sock.settimeout(0.25)
+        eprint(f"[racon_tpu::serve] listening on {self.socket_path} "
+               f"(queue {self.scheduler.max_queue}, "
+               f"jobs {self.scheduler.max_jobs}, "
+               f"idle_timeout {self.idle_timeout or 'off'})")
+        self._touch()   # prewarm time must not count against idle
+        try:
+            while True:
+                if self._stop.is_set():
+                    # drain mode: keep ACCEPTING so new submissions
+                    # get a structured "draining" reject (and status
+                    # keeps answering) while admitted jobs finish;
+                    # the loop ends once the last one has
+                    if not self.scheduler.draining:
+                        eprint("[racon_tpu::serve] draining: "
+                               "finishing queued/running jobs, "
+                               "rejecting new ones")
+                        self.scheduler.start_drain()
+                    if self.scheduler.idle():
+                        break
+                elif self._idle_expired():
+                    eprint("[racon_tpu::serve] idle timeout reached, "
+                           "shutting down")
+                    break
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                self._touch()
+                t = threading.Thread(target=self._serve_connection,
+                                     args=(conn,), daemon=True,
+                                     name="racon-serve-conn")
+                self._handlers.append(t)
+                t.start()
+                self._handlers = [h for h in self._handlers
+                                  if h.is_alive()]
+        finally:
+            self._shutdown()
+        return 0
+
+    def _shutdown(self) -> None:
+        with obs_trace.span("serve.drain", cat="serve"):
+            self.scheduler.drain()
+            # let blocked submit handlers flush their replies before
+            # the process goes away
+            for h in list(self._handlers):
+                h.join(timeout=10)
+        try:
+            self._sock.close()
+        finally:
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+        snap = self.scheduler.snapshot()
+        eprint(f"[racon_tpu::serve] drained "
+               f"({snap['completed']} job(s) served); bye")
+
+    def request_stop(self, *_sig) -> None:
+        self._stop.set()
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="racon-tpu serve",
+        description="Persistent polishing daemon: keeps compiled "
+        "kernels, the AOT shelf and calibration warm across jobs "
+        "submitted over a unix-domain socket (racon-tpu submit).")
+    p.add_argument("--socket", required=True,
+                   help="unix-domain socket path to listen on")
+    p.add_argument("--queue", type=int, default=None,
+                   help="max queued jobs before backpressure rejects "
+                   "(default: RACON_TPU_SERVE_QUEUE or 8)")
+    p.add_argument("--jobs", type=int, default=None,
+                   help="max concurrently running jobs (default: "
+                   "RACON_TPU_SERVE_JOBS or 2)")
+    p.add_argument("--idle-timeout", type=float, default=None,
+                   help="self-shutdown after this many idle seconds "
+                   "(default: RACON_TPU_SERVE_IDLE_S or 0 = never)")
+    # prewarm scoring config: the shelf variants are keyed by the
+    # scoring triple + trim, so the daemon warms the config its jobs
+    # will use (defaults match the one-shot CLI's)
+    p.add_argument("-m", "--match", type=int, default=3)
+    p.add_argument("-x", "--mismatch", type=int, default=-5)
+    p.add_argument("-g", "--gap", type=int, default=-4)
+    p.add_argument("--no-trimming", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    server = PolishServer(args.socket, max_queue=args.queue,
+                          max_jobs=args.jobs,
+                          idle_timeout=args.idle_timeout)
+    # graceful drain on SIGTERM/SIGINT (fleet managers send TERM)
+    signal.signal(signal.SIGTERM, server.request_stop)
+    signal.signal(signal.SIGINT, server.request_stop)
+    server.prewarm(args.match, args.mismatch, args.gap,
+                   not args.no_trimming)
+    return server.serve_forever()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,132 @@
+"""One served job's execution: polisher + per-job observability.
+
+A session is what the scheduler's worker runs for one admitted job:
+it builds a fresh polisher from the job spec (warm state — jit
+caches, shelved exports, calibration — is process-wide, so the fresh
+instance pays no compile cost on a warm server), polishes, and
+assembles the response: the exact FASTA bytes the one-shot CLI would
+have written plus a ``--metrics-json``-style report from the job's
+own child registry.
+
+Per-job namespacing of process-wide counters: the AOT-shelf counters
+(``aot_shelf_hit/miss/fallback``) and the server's prewarm counter
+live in the GLOBAL registry (shelf state is per process — that is
+the point of a warm server).  So a job-level report does not
+accumulate every previous job's contacts, the session snapshots
+those counters around the polish and records the DELTA into the
+job's registry locally (no parent propagation): a second job on a
+warm server reports ``aot_shelf_miss == 0`` even though the process
+total keeps job 1's cold misses — the warm-start assertion
+tests/test_serve.py pins.  With several jobs in flight the deltas
+can attribute a concurrent job's contact to this job (counters are
+process-wide); first-contact shelf semantics make that a one-time,
+cold-window-only ambiguity.
+
+Crash containment: any exception inside the polish is caught and
+returned as a structured ``job_failed`` error; the polisher (and its
+thread pool) is closed either way, and nothing the job touched can
+poison the queue or the warm engines.
+"""
+
+from __future__ import annotations
+
+import base64
+
+from racon_tpu import obs
+from racon_tpu.obs import REGISTRY
+from racon_tpu.obs import trace as obs_trace
+
+#: global counters the session re-reports per job as deltas
+_PROCESS_COUNTERS = ("aot_shelf_hit", "aot_shelf_miss",
+                     "aot_shelf_fallback", "serve_prewarm_runs")
+
+#: job-spec option defaults — exactly the one-shot CLI's
+#: (racon_tpu/cli.py parse_args), so an option the client omits
+#: resolves the same way the CLI would
+OPTION_DEFAULTS = {
+    "type": "kC", "window_length": 500, "quality_threshold": 10.0,
+    "error_threshold": 0.3, "trim": True, "match": 3, "mismatch": -5,
+    "gap": -4, "threads": 1, "drop_unpolished": True,
+    "tpu_poa_batches": 0, "tpu_banded_alignment": False,
+    "tpu_aligner_batches": 0,
+}
+
+
+def _resolve_options(spec: dict) -> dict:
+    opts = dict(OPTION_DEFAULTS)
+    for key in OPTION_DEFAULTS:
+        if key in spec:
+            opts[key] = spec[key]
+    return opts
+
+
+def run_job(job) -> dict:
+    """Execute one admitted job; returns the response frame body."""
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.obs import provenance
+
+    spec = job.spec
+    opts = _resolve_options(spec)
+    base = {k: REGISTRY.value(k) for k in _PROCESS_COUNTERS}
+    t0 = obs.now()
+    polisher = None
+    try:
+        with obs.span("serve.job", cat="serve",
+                      args={"job": job.id,
+                            "priority": job.priority}):
+            polisher = create_polisher(
+                spec["sequences"], spec["overlaps"], spec["targets"],
+                PolisherType[opts["type"]], opts["window_length"],
+                opts["quality_threshold"], opts["error_threshold"],
+                opts["trim"], opts["match"], opts["mismatch"],
+                opts["gap"], opts["threads"],
+                opts["tpu_poa_batches"], opts["tpu_banded_alignment"],
+                opts["tpu_aligner_batches"])
+            polisher.initialize()
+            polished = polisher.polish(opts["drop_unpolished"])
+        fasta = b"".join(b">" + s.name.encode() + b"\n" + s.data
+                         + b"\n" for s in polished)
+    except Exception as exc:
+        # containment boundary: InvalidInputError / parser errors are
+        # the expected bad-job shapes, but ANY failure must release
+        # the polisher and leave the server serving
+        if polisher is not None:
+            polisher.close()
+        REGISTRY.add("serve_jobs_failed")
+        obs_trace.TRACER.add_instant(
+            "serve.job_failed", cat="serve",
+            args={"job": job.id, "type": type(exc).__name__})
+        return {"ok": False,
+                "error": {"code": "job_failed",
+                          "type": type(exc).__name__,
+                          "reason": str(exc)}}
+
+    wall = obs.now() - t0
+    m = polisher.metrics
+    # per-job namespaced process counters: local writes only, so the
+    # process totals (and every other job's registry) stay untouched
+    for name in _PROCESS_COUNTERS:
+        m.set_local(name, REGISTRY.value(name) - base[name])
+    m.set_local("job_wall_s", round(wall, 6))
+    report = provenance.metrics_doc(
+        run_registry=m,
+        details={
+            "stage_walls": {k: round(v, 6) for k, v in
+                            getattr(polisher, "stage_walls",
+                                    {}).items()},
+            "poa_split_detail": getattr(polisher, "poa_split_detail",
+                                        {}),
+        },
+        probe=False)
+    polisher.close()
+    REGISTRY.add("serve_jobs_completed")
+    REGISTRY.add("serve_busy_s", wall)
+    return {
+        "ok": True,
+        "job_id": job.id,
+        "n_sequences": fasta.count(b">"),
+        "wall_s": round(wall, 6),
+        "estimate": job.estimate,
+        "fasta_b64": base64.b64encode(fasta).decode("ascii"),
+        "report": report,
+    }

@@ -154,6 +154,11 @@ def store_rates(stage: str, n_dev: int, dev_rate: float,
     raises."""
     if not dev_rate > 0 or (cpu_rate is not None and not cpu_rate > 0):
         return
+    if os.environ.get("RACON_TPU_CALIB_FREEZE"):
+        # serve mode: a served job's bytes must match a standalone
+        # CLI run at server-start calibration state, so jobs read
+        # rates but never store them (racon_tpu/serve/server.py)
+        return
     try:
         path = _calib_path()
         if path is None:

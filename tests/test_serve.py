@@ -1,0 +1,406 @@
+"""Persistent polishing service (racon_tpu/serve) — ISSUE 5.
+
+End-to-end on the CPU backend, pinning the serving contract:
+
+* **byte identity** — a job submitted to a running daemon returns
+  EXACTLY the bytes the one-shot CLI writes for the same inputs/
+  flags/threads/devices, including with two jobs in flight
+  concurrently (their megabatches interleave through the shared
+  device FIFO; assignment inside each job is a pure function of its
+  input, so interleaving changes only timing);
+* **warm start** — job 2 on a warm server performs zero AOT-shelf
+  compiles and triggers no prewarm: its per-job report (the PR 4
+  metrics registry, delta-namespaced per job by
+  racon_tpu/serve/session.py) shows ``aot_shelf_miss == 0`` and
+  ``serve_prewarm_runs == 0``, while the process counter pins that
+  the startup prewarm ran exactly once for both jobs;
+* **backpressure** — a submission past the queue bound gets an
+  immediate machine-readable ``queue_full`` reject carrying
+  depth/bound, without disturbing the queued/running jobs;
+* **graceful drain** — SIGTERM finishes admitted jobs (byte-exact),
+  answers new submissions with a structured ``draining`` reject,
+  then exits 0 and removes the socket;
+* **crash containment** — a malformed job answers ``job_failed``
+  and the server keeps serving;
+* **idle timeout** — an idle server with ``--idle-timeout`` reaps
+  itself.
+
+The queue tests use the daemon's ``pause``/``resume`` ops to make
+queue occupancy deterministic instead of racing job walls.
+"""
+
+import base64
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from racon_tpu.serve import client  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fixtures: dataset, golden bytes, daemon factory
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_tmp():
+    # unix-socket paths must stay short (~108 bytes); pytest tmp
+    # paths routinely exceed that, so sockets live in a mkdtemp
+    with tempfile.TemporaryDirectory(prefix="rtserve_",
+                                     dir="/tmp") as d:
+        yield d
+
+
+@pytest.fixture(scope="module")
+def dataset(serve_tmp):
+    from racon_tpu.tools import simulate
+
+    return simulate.simulate(os.path.join(serve_tmp, "data"),
+                             genome_len=8_000, coverage=5,
+                             read_len=800, seed=21, ont=True)
+
+
+def _serve_env(serve_tmp, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        # one cache root for golden + every daemon: the XLA compile
+        # cache only affects speed, and the pinned rates below keep
+        # bytes independent of calibration state
+        "RACON_TPU_CACHE_DIR": os.path.join(serve_tmp, "cache"),
+        "RACON_TPU_CLI_PREWARM": "0",
+        "RACON_TPU_RATE_POA_DEV": "0.30",
+        "RACON_TPU_RATE_POA_CPU": "2.0",
+        "RACON_TPU_RATE_ALIGN_DEV": "1100",
+        "RACON_TPU_RATE_ALIGN_CPU": "4.0",
+        "RACON_TPU_RATE_ALIGN_WFA_DEV": "700",
+        "RACON_TPU_RATE_ALIGN_WFA_CPU": "1.0",
+    })
+    env.pop("RACON_TPU_TRACE", None)
+    env.pop("RACON_TPU_METRICS_JSON", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+@pytest.fixture(scope="module")
+def golden(dataset, serve_tmp):
+    """One-shot CLI bytes — the serving contract's reference."""
+    reads, paf, draft = dataset
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "4", "-c", "1",
+         "--tpualigner-batches", "1", reads, paf, draft],
+        cwd=REPO_ROOT, capture_output=True,
+        env=_serve_env(serve_tmp), timeout=600)
+    assert run.returncode == 0, run.stderr.decode()
+    assert run.stdout.startswith(b">")
+    return run.stdout
+
+
+def _spec(dataset):
+    reads, paf, draft = dataset
+    return {"sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 4, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1}
+
+
+def _start_server(serve_tmp, name, args=(), extra_env=None):
+    sock_path = os.path.join(serve_tmp, name + ".sock")
+    log = open(os.path.join(serve_tmp, name + ".log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve",
+         "--socket", sock_path, *args],
+        cwd=REPO_ROOT, stdout=log, stderr=log,
+        env=_serve_env(serve_tmp, extra_env))
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            log.close()
+            raise AssertionError(
+                "server died at startup: " + open(log.name).read())
+        if os.path.exists(sock_path):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock_path)
+            except OSError:
+                pass
+            else:
+                probe.close()
+                log.close()
+                return proc, sock_path
+            finally:
+                probe.close()
+        time.sleep(0.2)
+    proc.kill()
+    log.close()
+    raise AssertionError("server socket never came up")
+
+
+@pytest.fixture(scope="module")
+def main_server(serve_tmp):
+    """One warm daemon shared by the e2e/warm/concurrency tests
+    (sharing IS the point: the warm assertions need job history)."""
+    proc, sock_path = _start_server(serve_tmp, "main",
+                                    args=("--jobs", "2"))
+    yield proc, sock_path
+    if proc.poll() is None:
+        try:
+            client.admin(sock_path, "shutdown")
+        except client.ServeError:
+            proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# e2e + warm start + concurrency (ordered on the shared daemon)
+# ---------------------------------------------------------------------------
+
+def test_serve_e2e_byte_identical(main_server, dataset, golden):
+    _, sock_path = main_server
+    resp = client.submit(sock_path, _spec(dataset))
+    assert resp["ok"], resp
+    assert base64.b64decode(resp["fasta_b64"]) == golden, (
+        "served job diverged from the one-shot CLI bytes")
+    # the response embeds a --metrics-json-style report
+    rep = resp["report"]
+    assert rep["schema"] == "racon-tpu-metrics-v1"
+    assert "RACON_TPU_SERVE_QUEUE" in rep["environment"]["knobs"]
+    assert rep["run"]["gauges"]["job_wall_s"] > 0
+    assert "stage_wall_s.device_poa" in rep["run"]["gauges"]
+    assert "estimate" in resp and "predicted_wall_s" in resp["estimate"]
+
+
+def test_serve_warm_start_zero_compiles(main_server, dataset, golden):
+    """Job 2 on a warm server: no shelf miss, no prewarm — and the
+    startup prewarm ran exactly once for the whole server life."""
+    _, sock_path = main_server
+    resp = client.submit(sock_path, _spec(dataset))
+    assert resp["ok"], resp
+    assert base64.b64decode(resp["fasta_b64"]) == golden
+    gauges = resp["report"]["run"]["gauges"]
+    assert gauges["aot_shelf_miss"] == 0, (
+        "warm job recompiled shelf variants")
+    assert gauges["aot_shelf_fallback"] == 0
+    assert gauges["serve_prewarm_runs"] == 0, (
+        "warm job re-triggered the startup prewarm")
+    # prewarm-once across the server's whole life
+    proc_counters = resp["report"]["process"]["counters"]
+    assert proc_counters["serve_prewarm_runs"] == 1
+    # per-job registries do not accumulate: job 2's own job counter
+    # is its own (server has served >= 2 jobs by now)
+    assert proc_counters["serve_jobs_submitted"] >= 2
+
+
+def test_serve_concurrent_jobs_byte_identical(main_server, dataset,
+                                              golden):
+    """Two jobs in flight at once (jobs=2 workers): megabatches
+    interleave through the shared device, bytes must not move."""
+    _, sock_path = main_server
+    results = [None, None]
+
+    def run(slot):
+        results[slot] = client.submit(sock_path, _spec(dataset))
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, resp in enumerate(results):
+        assert resp["ok"], resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden, (
+            f"concurrent job {i} diverged from the one-shot bytes")
+    # both really went through one server process
+    assert results[0]["job_id"] != results[1]["job_id"]
+
+
+def test_serve_crash_containment(main_server, dataset, golden):
+    """A malformed job fails structurally; the daemon keeps serving
+    warm jobs afterwards."""
+    _, sock_path = main_server
+    bad = dict(_spec(dataset))
+    bad["overlaps"] = bad["targets"]   # .fasta is no overlap format
+    resp = client.submit(sock_path, bad)
+    assert not resp["ok"]
+    assert resp["error"]["code"] == "job_failed"
+    assert resp["error"]["type"] == "UnsupportedFormatError"
+
+    missing = dict(_spec(dataset))
+    missing["sequences"] = os.path.join(
+        os.path.dirname(missing["sequences"]), "nope.fastq")
+    resp = client.submit(sock_path, missing)
+    assert not resp["ok"]
+    assert resp["error"]["code"] == "input_not_found"
+
+    # still healthy: status answers and queue is clean
+    doc = client.status(sock_path)
+    assert doc["ok"] and doc["queue"]["queue_depth"] == 0
+    assert not doc["queue"]["draining"]
+    assert "provenance" in doc and "registry" in doc
+    assert doc["registry"]["counters"]["serve_prewarm_runs"] == 1
+
+
+# ---------------------------------------------------------------------------
+# backpressure + graceful drain (own constrained daemon)
+# ---------------------------------------------------------------------------
+
+def test_serve_backpressure_and_sigterm_drain(serve_tmp, dataset,
+                                              golden):
+    proc, sock_path = _start_server(
+        serve_tmp, "bp", args=("--jobs", "1", "--queue", "1"))
+    try:
+        # pause the workers so queue occupancy is deterministic
+        assert client.admin(sock_path, "pause")["ok"]
+        held = {}
+        t1 = threading.Thread(
+            target=lambda: held.update(
+                r=client.submit(sock_path, _spec(dataset))))
+        t1.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if client.status(sock_path)["queue"]["queue_depth"] == 1:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("queued job never landed")
+
+        # queue full -> structured, immediate backpressure reject
+        resp = client.submit(sock_path, _spec(dataset))
+        assert not resp["ok"]
+        err = resp["error"]
+        assert err["code"] == "queue_full"
+        assert err["queue_depth"] == 1 and err["max_queue"] == 1
+        # the queued job was not disturbed
+        assert client.status(sock_path)["queue"]["queue_depth"] == 1
+
+        # SIGTERM: drain resumes the paused queue, finishes the
+        # admitted job, rejects new ones with "draining"
+        proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                if client.status(
+                        sock_path)["queue"]["draining"]:
+                    break
+            except client.ServeError:
+                break   # already gone (job finished fast)
+            time.sleep(0.1)
+        try:
+            resp = client.submit(sock_path, _spec(dataset))
+            assert not resp["ok"]
+            assert resp["error"]["code"] == "draining"
+        except client.ServeError:
+            pass   # server finished its drain before our submit
+
+        t1.join(timeout=300)
+        assert not t1.is_alive(), "queued job never finished"
+        assert held["r"]["ok"], held["r"]
+        assert base64.b64decode(held["r"]["fasta_b64"]) == golden, (
+            "job drained through SIGTERM diverged from the one-shot "
+            "bytes")
+        assert proc.wait(timeout=60) == 0
+        assert not os.path.exists(sock_path), (
+            "drained server left its socket behind")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# idle timeout + admission pricing
+# ---------------------------------------------------------------------------
+
+def test_serve_idle_timeout_self_shutdown(serve_tmp):
+    proc, sock_path = _start_server(
+        serve_tmp, "idle", args=("--idle-timeout", "1.5"))
+    try:
+        assert proc.wait(timeout=60) == 0
+        assert not os.path.exists(sock_path)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_admission_pricing_rejects_monster_jobs(dataset, monkeypatch):
+    """Admission control prices a job from input stats through
+    calibrate.predict_walls and rejects past the wall cap — pure
+    scheduler logic, no daemon needed."""
+    from racon_tpu.serve.scheduler import JobScheduler, RejectError
+
+    reads, paf, draft = dataset
+    spec = {"sequences": reads, "overlaps": paf, "targets": draft}
+    sched = JobScheduler(lambda job: {"ok": True}, max_queue=2,
+                         max_jobs=1)
+    try:
+        monkeypatch.setenv("RACON_TPU_SERVE_MAX_WALL_S", "0.000001")
+        with pytest.raises(RejectError) as exc_info:
+            sched.submit(spec)
+        err = exc_info.value.error
+        assert err["code"] == "job_too_large"
+        est = err["estimate"]
+        assert est["predicted_wall_s"] >= est["overlapped_floor_s"]
+        assert set(est["input_bytes"]) == {"sequences", "overlaps",
+                                           "targets"}
+        monkeypatch.delenv("RACON_TPU_SERVE_MAX_WALL_S")
+        job = sched.submit(spec)
+        job.done.wait(timeout=30)
+        assert job.result == {"ok": True}
+    finally:
+        sched.drain(timeout=10)
+
+
+def test_protocol_roundtrip_and_guards():
+    """Frame layer: roundtrip, clean EOF, corrupt-length guard."""
+    from racon_tpu.serve import protocol
+
+    a, b = socket.socketpair()
+    try:
+        protocol.send_frame(a, {"op": "status", "n": 3})
+        assert protocol.recv_frame(b) == {"op": "status", "n": 3}
+        a.close()
+        assert protocol.recv_frame(b) is None     # clean EOF
+    finally:
+        b.close()
+
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\xff\xff\xff\xff")            # 4 GiB "length"
+        with pytest.raises(protocol.ProtocolError):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_bad_frame_is_contained(main_server):
+    """A garbage frame gets a bad_request answer (not a dead
+    server)."""
+    _, sock_path = main_server
+    sock = socket.socket(socket.AF_UNIX)
+    try:
+        sock.connect(sock_path)
+        import struct
+        sock.sendall(struct.pack(">I", 8) + b"not{json")
+        resp = client.request(sock_path, {"op": "status"})
+        assert resp["ok"]   # server survived the garbage
+    finally:
+        sock.close()
+    resp = client.request(sock_path, {"op": "frobnicate"})
+    assert not resp["ok"]
+    assert resp["error"]["code"] == "bad_request"
