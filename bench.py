@@ -578,18 +578,53 @@ def _mega_leg(prefix, label, sim_kwargs, tpu_need_s, cpu_need_s,
                 tm.value("poa_spec_used")),
             f"{prefix}_poa_split_detail": getattr(
                 tpol, "poa_split_detail", {}),
+            # host data-plane wall split (r7): CPU-seconds per host
+            # stage from the obs registry, plus the derived share of
+            # the run wall -- BENCH tracks the host wall directly
+            # instead of inferring it from device share
+            f"{prefix}_host_parse_s": round(
+                tm.value("host.parse_s"), 3),
+            f"{prefix}_host_bp_decode_s": round(
+                tm.value("host.bp_decode_s"), 3),
+            f"{prefix}_host_fragment_s": round(
+                tm.value("host.fragment_s"), 3),
+            f"{prefix}_host_stitch_s": round(
+                tm.value("host.stitch_s"), 3),
+            f"{prefix}_host_stage_s": round(
+                tm.value("host.stage_s"), 3),
+            f"{prefix}_host_share": round(tm.value("host.share"), 3),
         }
         log(f"[bench] {prefix} align engines: wfa "
             f"{out[f'{prefix}_align_wfa_device_s']:.2f}s device, "
             f"band {out[f'{prefix}_align_band_device_s']:.2f}s; "
             f"rung retries {getattr(tpol, 'align_retry_counts', {})}")
+        log(f"[bench] {prefix} wall split: host "
+            f"{out[f'{prefix}_host_stage_s']:.1f}s cpu-s "
+            f"(share {out[f'{prefix}_host_share']:.0%}: parse "
+            f"{out[f'{prefix}_host_parse_s']:.1f} / decode "
+            f"{out[f'{prefix}_host_bp_decode_s']:.1f} / fragment "
+            f"{out[f'{prefix}_host_fragment_s']:.1f} / stitch "
+            f"{out[f'{prefix}_host_stitch_s']:.1f}), device poa "
+            f"{out[f'{prefix}_poa_device_s']:.1f}s + align "
+            f"{out[f'{prefix}_align_device_s']:.1f}s")
         want_cpu = os.environ.get(f"{enable_env}_CPU", "1") == "1"
+        # structured skip provenance (r7): a missing CPU pair must say
+        # WHY in the record itself, not just in scrollback (r5 shipped
+        # mega_ont's skip invisibly)
+        skip_reason = None
+        if not want_cpu:
+            skip_reason = {"reason": "disabled_by_env",
+                           "env": f"{enable_env}_CPU"}
         if want_cpu and defer_cpu_for_s and \
                 _budget_remaining() < (cpu_need_s + defer_cpu_for_s):
             log(f"[bench] deferring {prefix} CPU reference leg "
                 f"(another leg's CPU pair is due this round; "
                 "carrying the previous measurement forward)")
             want_cpu = False
+            skip_reason = {
+                "reason": "deferred_for_other_leg",
+                "needed_s": round(cpu_need_s + defer_cpu_for_s, 1),
+                "remaining_s": round(_budget_remaining(), 1)}
         if want_cpu and _budget_left(cpu_need_s,
                                      f"{prefix} CPU reference leg"):
             cpu_wall, cpu_out, _ = run(0, 0)
@@ -608,6 +643,12 @@ def _mega_leg(prefix, label, sim_kwargs, tpu_need_s, cpu_need_s,
         # CPU leg not run this round: carry the newest MEASURED wall
         # forward with explicit provenance so the record still pairs
         # the TPU number against a real CPU reference
+        if skip_reason is None:
+            skip_reason = {
+                "reason": "budget_exhausted",
+                "needed_s": round(cpu_need_s, 1),
+                "remaining_s": round(_budget_remaining(), 1)}
+        out[f"{prefix}_cpu_skip_reason"] = skip_reason
         src, wall, dist = _carried_cpu_leg(prefix)
         if wall is not None:
             out[f"{prefix}_cpu_wall_s"] = wall

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Host data plane tier-1 (ISSUE r7 CI satellite): the vectorized
+# ingest + batched breaking-point decode path must be a pure
+# optimization — same records, same chunking, same bytes.
+#
+#   1. tier-1 with RACON_TPU_FAST_IO=1 pinned ON (it is the default,
+#      but the pin keeps this lane meaningful if the default ever
+#      changes) under PYTHONDEVMODE=1, which surfaces unclosed mmaps/
+#      files and unjoined threads in the scan parsers and the slab
+#      decode pool;
+#   2. fast-io on/off FASTA byte-identity on the sample dataset: one
+#      CLI polish per setting, outputs compared byte for byte.  The
+#      in-suite twin (tests/test_fastio.py) pins the same identity on
+#      simulated data; this leg covers real reads when the reference
+#      checkout provides them, and degrades to the simulator when not.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export RACON_TPU_FAST_IO=1
+export PYTHONDEVMODE=1
+python -m pytest tests/ -q -m "not slow" \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"
+
+echo "[hostpath_tier1] fast-io on/off byte identity"
+DATA="${RACON_TPU_REFERENCE_DATA:-/root/reference/test/data}"
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+if [ -f "$DATA/sample_reads.fastq.gz" ] \
+        && [ -f "$DATA/sample_overlaps.paf.gz" ] \
+        && [ -f "$DATA/sample_layout.fasta.gz" ]; then
+    READS="$DATA/sample_reads.fastq.gz"
+    OVLS="$DATA/sample_overlaps.paf.gz"
+    DRAFT="$DATA/sample_layout.fasta.gz"
+else
+    echo "[hostpath_tier1] no reference data; simulating"
+    python - "$work" <<'EOF'
+import sys
+from racon_tpu.tools import simulate
+simulate.simulate(sys.argv[1], genome_len=30_000, coverage=8,
+                  read_len=1_000, seed=33, ont=True)
+EOF
+    READS="$work/reads.fastq"
+    OVLS="$work/reads2draft.paf"
+    DRAFT="$work/draft.fasta"
+fi
+JAX_PLATFORMS=cpu RACON_TPU_FAST_IO=1 \
+    python -m racon_tpu.cli -t 4 "$READS" "$OVLS" "$DRAFT" \
+    > "$work/fast.fasta"
+JAX_PLATFORMS=cpu RACON_TPU_FAST_IO=0 \
+    python -m racon_tpu.cli -t 4 "$READS" "$OVLS" "$DRAFT" \
+    > "$work/slow.fasta"
+cmp "$work/fast.fasta" "$work/slow.fasta"
+echo "HOSTPATH CI PASS"

@@ -199,15 +199,27 @@ def _log_run_summary(polisher, opts) -> None:
     inside bench runs; a production polish should say whether its
     speculation paid off without re-running under bench.py."""
     m = getattr(polisher, "metrics", None)
-    if m is None or opts["tpu_poa_batches"] <= 0:
+    if m is None:
         return
-    print("[racon_tpu::] pipeline summary: "
-          f"spec used {int(m.value('poa_spec_used'))}"
-          f"/wasted {int(m.value('poa_spec_wasted'))} window(s), "
-          f"ledger ready peak {int(m.value('ledger_ready_high_water'))}, "
-          f"overlap {float(m.value('pipeline_overlap_s')):.2f} s, "
-          f"device poa {float(m.value('poa_device_s')):.2f} s / "
-          f"align {float(m.value('align_device_s')):.2f} s",
+    if opts["tpu_poa_batches"] > 0:
+        print("[racon_tpu::] pipeline summary: "
+              f"spec used {int(m.value('poa_spec_used'))}"
+              f"/wasted {int(m.value('poa_spec_wasted'))} window(s), "
+              "ledger ready peak "
+              f"{int(m.value('ledger_ready_high_water'))}, "
+              f"overlap {float(m.value('pipeline_overlap_s')):.2f} s, "
+              f"device poa {float(m.value('poa_device_s')):.2f} s / "
+              f"align {float(m.value('align_device_s')):.2f} s",
+              file=sys.stderr)
+    # host data-plane budget (r7): where host CPU-seconds went and
+    # their share of the run wall, so "is the host the wall" is
+    # answerable from a production run's stderr (CPU-only runs too)
+    print("[racon_tpu::] host budget: "
+          f"parse {float(m.value('host.parse_s')):.2f} s, "
+          f"bp decode {float(m.value('host.bp_decode_s')):.2f} s, "
+          f"fragment {float(m.value('host.fragment_s')):.2f} s, "
+          f"stitch {float(m.value('host.stitch_s')):.2f} s, "
+          f"host share {float(m.value('host.share')):.3f}",
           file=sys.stderr)
 
 
@@ -276,8 +288,10 @@ def main(argv=None):
         raise SystemExit(1)
 
     out = sys.stdout.buffer
-    for seq in polished:
-        out.write(b">" + seq.name.encode() + b"\n" + seq.data + b"\n")
+    # one write per record batch instead of 4 syscall-sized pieces per
+    # record: serialization is part of the host wall on the mega leg
+    out.write(b"".join(b">" + seq.name.encode() + b"\n" + seq.data
+                       + b"\n" for seq in polished))
     # flush the TEXT layer before the buffer layer: anything printed
     # via print()/sys.stdout sits in the text wrapper, and os._exit
     # skips the interpreter teardown that would normally drain it --

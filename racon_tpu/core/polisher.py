@@ -15,6 +15,7 @@ import concurrent.futures
 import enum
 from typing import Dict, List, Optional
 
+from racon_tpu.core import overlap as overlap_mod
 from racon_tpu.core.overlap import InvalidInputError, Overlap
 from racon_tpu.core.sequence import Sequence
 from racon_tpu.core.window import Window, WindowType
@@ -119,7 +120,12 @@ class Polisher:
             return
 
         self.logger.log()
-        with obs_trace.span("racon_tpu.load_targets", cat="stage"):
+        # run-wall anchor for the derived host.share gauge (obs clock;
+        # records only, never feeds control flow)
+        self._t_run_start = obs_trace.now()
+        with obs_trace.span("racon_tpu.load_targets", cat="stage",
+                            metric="host.parse_s",
+                            registry=self.metrics):
             self.tparser.reset()
             self.tparser.parse(self.sequences, -1)
         targets_size = len(self.sequences)
@@ -193,8 +199,10 @@ class Polisher:
             self.sequences.extend(kept)
             if not status:
                 break
+        _t_seq_end = obs_trace.now()
         obs_trace.TRACER.add_span("racon_tpu.load_sequences", _t_seq,
-                                  obs_trace.now(), cat="stage")
+                                  _t_seq_end, cat="stage")
+        self.metrics.add("host.parse_s", _t_seq_end - _t_seq)
 
         if sequences_size == 0:
             raise InvalidInputError("empty sequences set!")
@@ -216,7 +224,9 @@ class Polisher:
         self.logger.log("[racon_tpu::Polisher::initialize] loaded sequences")
         self.logger.log()
 
-        with obs_trace.span("racon_tpu.load_overlaps", cat="stage"):
+        with obs_trace.span("racon_tpu.load_overlaps", cat="stage",
+                            metric="host.parse_s",
+                            registry=self.metrics):
             overlaps = self._load_overlaps(name_to_id, id_to_id,
                                            has_data, has_reverse_data)
         # a multi-host rank may legitimately own zero overlaps (its
@@ -323,7 +333,35 @@ class Polisher:
     # accelerator seam #1 (reference: src/polisher.cpp:461-483)
     # ------------------------------------------------------------------
 
+    def _batch_decode_breaking_points(self,
+                                      overlaps: List[Overlap]) -> None:
+        """Vectorized pre-pass: decode breaking points for every
+        overlap already carrying ``cigar_runs`` (SAM ingest, or a
+        staged device-align pass) in slab-sized batches fanned over
+        the pool — ``work(o)`` then sees points set and skips the
+        per-overlap walk.  A failed slab is left undecoded so the
+        per-overlap path isolates a poison record to its own error."""
+        slabs = overlap_mod.iter_decode_slabs(overlaps)
+        if not slabs:
+            return
+
+        def one(slab):
+            try:
+                with self.metrics.timer("host.bp_decode_s"):
+                    overlap_mod.decode_breaking_points_batch(
+                        slab, self.window_length)
+            except Exception:
+                pass
+
+        if len(slabs) > 1 and self.num_threads > 1:
+            list(self._pool.map(one, slabs))
+        else:
+            for slab in slabs:
+                one(slab)
+
     def find_overlap_breaking_points(self, overlaps: List[Overlap]) -> None:
+        self._batch_decode_breaking_points(overlaps)
+
         def work(o: Overlap) -> None:
             o.find_breaking_points(self.sequences, self.window_length,
                                    aligner=cpu.align)
@@ -400,8 +438,10 @@ class Polisher:
         factored out so the streaming seam can route per overlap as
         alignments complete.  Caller clears ``o.breaking_points``."""
         points = o.breaking_points
-        if points is None:
+        if points is None or len(points) == 0:
             return
+        import numpy as np
+
         w = self.window_length
         sequence = self.sequences[o.q_id]
         # check the stored slot: reverse_quality exists iff transmute
@@ -413,37 +453,51 @@ class Polisher:
                        else sequence.quality)
         data_src = (sequence.reverse_complement if o.strand
                     else sequence.data)
-        for j in range(0, len(points), 2):
-            t_first, q_first = int(points[j][0]), int(points[j][1])
-            t_last, q_last = int(points[j + 1][0]), int(points[j + 1][1])
-            if q_last - q_first < 0.02 * w:
-                continue
-            if has_quality and quality_src:
-                frag_q = quality_src[q_first:q_last]
-                average_quality = (sum(frag_q) / len(frag_q)) - 33
-                if average_quality < self.quality_threshold:
-                    continue
-            window_id = self._first_window_id[o.t_id] + t_first // w
-            window_start = (t_first // w) * w
-            data = data_src[q_first:q_last]
-            quality = quality_src[q_first:q_last] if quality_src else None
-            yield (window_id, data, quality, t_first - window_start,
-                   t_last - window_start - 1)
+        pts = np.asarray(points, dtype=np.int64)
+        t_first = pts[0::2, 0]
+        q_first = pts[0::2, 1]
+        t_last = pts[1::2, 0]
+        q_last = pts[1::2, 1]
+        keep = (q_last - q_first) >= 0.02 * w
+        if has_quality and quality_src:
+            idx = np.flatnonzero(keep)
+            if idx.size:
+                # prefix sums turn each fragment's mean quality into
+                # two gathers; int64/int64 true division matches the
+                # old Python sum()/len() float exactly (sums < 2^53)
+                prefix = np.concatenate(([0], np.cumsum(
+                    np.frombuffer(quality_src, np.uint8)
+                    .astype(np.int64))))
+                total = prefix[q_last[idx]] - prefix[q_first[idx]]
+                count = q_last[idx] - q_first[idx]
+                keep[idx] = ~((total / count - 33)
+                              < self.quality_threshold)
+        first_wid = self._first_window_id[o.t_id]
+        for j in np.flatnonzero(keep).tolist():
+            tf, tl = int(t_first[j]), int(t_last[j])
+            qf, ql = int(q_first[j]), int(q_last[j])
+            window_start = (tf // w) * w
+            yield (first_wid + tf // w, data_src[qf:ql],
+                   quality_src[qf:ql] if quality_src else None,
+                   tf - window_start, tl - window_start - 1)
 
     def _build_windows(self, targets_size: int, window_type: WindowType,
                        overlaps: List[Overlap]) -> None:
         self._create_windows(targets_size, window_type)
-        for o in overlaps:
-            if not self._coverage_counted:
-                self.targets_coverages[o.t_id] += 1
-            if o.breaking_points is None:
-                # already routed by the streaming seam (or carried no
-                # points at all)
-                continue
-            for wid, data, quality, begin, end in \
-                    self._overlap_window_fragments(o):
-                self.windows[wid].add_layer(data, quality, begin, end)
-            o.breaking_points = None
+        with self.metrics.timer("host.fragment_s"):
+            for o in overlaps:
+                if not self._coverage_counted:
+                    self.targets_coverages[o.t_id] += 1
+                if o.breaking_points is None or \
+                        len(o.breaking_points) == 0:
+                    # already routed by the streaming seam (the ROUTED
+                    # sentinel) or carried no points at all
+                    continue
+                for wid, data, quality, begin, end in \
+                        self._overlap_window_fragments(o):
+                    self.windows[wid].add_layer(data, quality, begin,
+                                                end)
+                o.breaking_points = None
 
     # ------------------------------------------------------------------
     # accelerator seam #2 + polish (reference: src/polisher.cpp:485-547)
@@ -464,27 +518,58 @@ class Polisher:
                             registry=self.metrics):
             polished_flags = self.generate_consensuses()
 
-        dst: List[Sequence] = []
-        polished_data = bytearray()
-        num_polished_windows = 0
-        for i, window in enumerate(self.windows):
-            num_polished_windows += 1 if polished_flags[i] else 0
-            polished_data += window.consensus
+        # stitch each target's window run independently and in
+        # parallel over the pool (the window list is read-only here);
+        # results collect in group order, so output bytes match the
+        # old sequential bytearray accumulation exactly
+        groups = []
+        start = 0
+        for i in range(len(self.windows)):
             if i == len(self.windows) - 1 or self.windows[i + 1].rank == 0:
-                polished_ratio = num_polished_windows / (window.rank + 1)
-                if not drop_unpolished_sequences or polished_ratio > 0:
-                    tags = "r" if self.type == PolisherType.kF else ""
-                    tags += f" LN:i:{len(polished_data)}"
-                    tags += f" RC:i:{self.targets_coverages[window.id]}"
-                    tags += f" XC:f:{polished_ratio:.6f}"
-                    dst.append(Sequence(
-                        self.sequences[window.id].name + tags,
-                        bytes(polished_data)))
-                num_polished_windows = 0
-                polished_data = bytearray()
+                groups.append((start, i + 1))
+                start = i + 1
+
+        def stitch(bounds) -> Optional[Sequence]:
+            lo, hi = bounds
+            num_polished_windows = sum(
+                1 for i in range(lo, hi) if polished_flags[i])
+            window = self.windows[hi - 1]
+            polished_ratio = num_polished_windows / (window.rank + 1)
+            if drop_unpolished_sequences and not polished_ratio > 0:
+                return None
+            polished_data = b"".join(self.windows[i].consensus
+                                     for i in range(lo, hi))
+            tags = "r" if self.type == PolisherType.kF else ""
+            tags += f" LN:i:{len(polished_data)}"
+            tags += f" RC:i:{self.targets_coverages[window.id]}"
+            tags += f" XC:f:{polished_ratio:.6f}"
+            return Sequence(self.sequences[window.id].name + tags,
+                            polished_data)
+
+        with self.metrics.timer("host.stitch_s"):
+            if len(groups) > 1 and self.num_threads > 1:
+                stitched = list(self._pool.map(stitch, groups))
+            else:
+                stitched = [stitch(g) for g in groups]
+        dst = [s for s in stitched if s is not None]
+        self._finish_host_budget()
         self.windows = []
         self.sequences = []
         return dst
+
+    def _finish_host_budget(self) -> None:
+        """Derive the run's host-stage budget gauges: total host data
+        -plane seconds (CPU-seconds — concurrent stages can sum past
+        the wall) and the share of the run wall they represent."""
+        host_s = sum(float(self.metrics.value(k, 0.0))
+                     for k in ("host.parse_s", "host.bp_decode_s",
+                               "host.fragment_s", "host.stitch_s"))
+        self.metrics.set("host.stage_s", round(host_s, 6))
+        wall = obs_trace.now() - getattr(self, "_t_run_start",
+                                         obs_trace.now())
+        if wall > 0:
+            self.metrics.set("host.share",
+                             round(min(1.0, host_s / wall), 6))
 
     def total_log(self) -> None:
         self.logger.total("[racon_tpu::Polisher::] total =")

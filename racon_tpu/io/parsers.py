@@ -221,9 +221,11 @@ class SamParser(_OverlapLineParser):
         if line.startswith(b"@"):
             return None
         f = line.split(b"\t")
-        return Overlap.from_sam(
+        # from_sam_bytes parses the CIGAR from the original bytes and
+        # populates cigar_runs directly — no str round trip
+        return Overlap.from_sam_bytes(
             q_name=f[0].decode(), flag=int(f[1]), t_name=f[2].decode(),
-            t_begin=int(f[3]), cigar=f[5].decode())
+            t_begin=int(f[3]), cigar=f[5])
 
 
 _SEQUENCE_EXTENSIONS_FASTA = (".fasta", ".fasta.gz", ".fna", ".fna.gz",
@@ -239,11 +241,25 @@ class MalformedInputError(ValueError):
     """A record violates its declared format (path:line diagnostics)."""
 
 
+def _fast_io_enabled() -> bool:
+    """RACON_TPU_FAST_IO selects the vectorized scan parsers
+    (io/fastio.py, default on); "0" is the escape hatch back to the
+    line parsers.  Read per parser construction so tests can flip it
+    between polishes."""
+    return os.environ.get("RACON_TPU_FAST_IO", "1") != "0"
+
+
 def create_sequence_parser(path: str):
     """Extension-sniffing factory (reference: src/polisher.cpp:83-99)."""
     if path.endswith(_SEQUENCE_EXTENSIONS_FASTA):
+        if _fast_io_enabled():
+            from racon_tpu.io import fastio
+            return fastio.FastaScanParser(path)
         return FastaParser(path)
     if path.endswith(_SEQUENCE_EXTENSIONS_FASTQ):
+        if _fast_io_enabled():
+            from racon_tpu.io import fastio
+            return fastio.FastqScanParser(path)
         return FastqParser(path)
     raise UnsupportedFormatError(
         f"file {path} has unsupported format extension (valid extensions: "
@@ -254,10 +270,19 @@ def create_sequence_parser(path: str):
 def create_overlap_parser(path: str):
     """Extension-sniffing factory (reference: src/polisher.cpp:101-115)."""
     if path.endswith((".mhap", ".mhap.gz")):
+        if _fast_io_enabled():
+            from racon_tpu.io import fastio
+            return fastio.MhapScanParser(path)
         return MhapParser(path)
     if path.endswith((".paf", ".paf.gz")):
+        if _fast_io_enabled():
+            from racon_tpu.io import fastio
+            return fastio.PafScanParser(path)
         return PafParser(path)
     if path.endswith((".sam", ".sam.gz")):
+        if _fast_io_enabled():
+            from racon_tpu.io import fastio
+            return fastio.SamScanParser(path)
         return SamParser(path)
     raise UnsupportedFormatError(
         f"file {path} has unsupported format extension (valid extensions: "

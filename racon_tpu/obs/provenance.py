@@ -47,6 +47,11 @@ KNOWN_KNOBS = {
     "RACON_TPU_POA_DEVICE_ONLY": "",
     "RACON_TPU_ALIGN_DEVICE_ONLY": "",
     "RACON_TPU_RECALIBRATE": "",
+    # host data plane (r7): vectorized ingest escape hatch, batched
+    # breaking-point decode slab budget, POA-split host reserve
+    "RACON_TPU_FAST_IO": "1",
+    "RACON_TPU_BP_COLS": "4000000",
+    "RACON_TPU_POA_HOST_RESERVE": "0.25",
     "RACON_TPU_CACHE_DIR": "",
     "RACON_TPU_TRACE": "",
     "RACON_TPU_METRICS_JSON": "",

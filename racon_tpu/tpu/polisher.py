@@ -24,6 +24,7 @@ from typing import List
 
 import numpy as np
 
+from racon_tpu.core import overlap as overlap_mod
 from racon_tpu.core.overlap import Overlap
 from racon_tpu.core.polisher import Polisher, PolisherType
 from racon_tpu.core.window import WindowType
@@ -247,6 +248,9 @@ class TPUPolisher(Polisher):
         self._consumer = None
         self._consumer_stop = False
         self._decode_futs = []
+        self._decode_buf = []
+        self._decode_buf_cols = 0
+        self._decode_col_budget = 4_000_000
         self._stream_errors = []
         self._stream_lock = threading.Lock()
         self._align_device_free = threading.Event()
@@ -368,6 +372,10 @@ class TPUPolisher(Polisher):
         self._ledger.seal()
         self._spec_results = {}
         self._decode_futs = []
+        self._decode_buf = []
+        self._decode_buf_cols = 0
+        self._decode_col_budget = max(
+            1, _env_int("RACON_TPU_BP_COLS", 4_000_000))
         self._consumer_stop = False
         self._poa_first_dispatch_t = None
         self._poa_engine = self._make_poa_engine()
@@ -386,11 +394,21 @@ class TPUPolisher(Polisher):
         if led is None or not self._pipeline_mode:
             return
         try:
-            if o.breaking_points is not None:
-                frags = [(self._ledger_ordinal(o), wid, data, qual, b, e)
-                         for wid, data, qual, b, e
-                         in self._overlap_window_fragments(o)]
-                o.breaking_points = None
+            if o.breaking_points is not None \
+                    and o.breaking_points is not overlap_mod.ROUTED:
+                with self.metrics.timer("host.fragment_s"):
+                    frags = [(self._ledger_ordinal(o), wid, data, qual,
+                              b, e)
+                             for wid, data, qual, b, e
+                             in self._overlap_window_fragments(o)]
+                # the ROUTED sentinel (a shared empty points array)
+                # tells the staged fall-through work(o) this overlap
+                # is done: find_breaking_points early-returns instead
+                # of RE-ALIGNING it on the CPU (pre-r7 the fall
+                # -through re-aligned every streamed overlap and threw
+                # the result away via the ledger's duplicate-complete
+                # no-op -- bytes were safe, host time was not)
+                o.breaking_points = overlap_mod.ROUTED
             else:
                 frags = []
             newly = led.complete(id(o), frags)
@@ -416,30 +434,71 @@ class TPUPolisher(Polisher):
             reg = self._ledger._reg.get(id(o))
         return reg[0] if reg else 0
 
-    def _finish_overlap(self, o: Overlap) -> None:
-        """Pool task: decode one device-aligned overlap's breaking
-        points while the device computes the next chunk, then advance
-        the completion ledger."""
+    def _finish_overlap_batch(self, batch: List[Overlap]) -> None:
+        """Pool task: decode a chunk's breaking points in ONE
+        vectorized pass (core/overlap.decode_breaking_points_batch)
+        while the device computes the next chunk, then advance the
+        completion ledger for every member.  Replaces the pre-r7
+        one-pool-task-per-overlap decode, whose per-record Python
+        CIGAR walk was the largest host stage on the mega bench."""
         try:
-            o.find_breaking_points(self.sequences, self.window_length)
-            self._notify_overlap_done(o)
-        except Exception as exc:
-            with self._stream_lock:
-                self._stream_errors.append(exc)
+            with self.metrics.timer("host.bp_decode_s"):
+                overlap_mod.decode_breaking_points_batch(
+                    batch, self.window_length)
+        except Exception:
+            # fall through to the per-overlap path, which isolates a
+            # poison record to its own error instead of the slab's
+            pass
+        for o in batch:
+            try:
+                if o.breaking_points is None:
+                    o.find_breaking_points(self.sequences,
+                                           self.window_length)
+                self._notify_overlap_done(o)
+            except Exception as exc:
+                with self._stream_lock:
+                    self._stream_errors.append(exc)
 
     def _stream_decode(self, o: Overlap) -> None:
-        """Queue breaking-point decode + ledger notify for an overlap
-        whose CIGAR just arrived from the device (no-op when the
+        """Buffer breaking-point decode + ledger notify for an overlap
+        whose alignment just arrived from the device (no-op when the
         pipeline is off: the staged fall-through pass handles it).
-        The queued futures are drained before the fall-through pass so
-        exactly one thread ever computes a given overlap's points."""
-        if self._pipeline_mode:
+        Buffers flush to the pool as a batch at a decode-column budget
+        (RACON_TPU_BP_COLS) and at each consume-chunk boundary
+        (_stream_decode_flush); the queued futures are drained before
+        the fall-through pass so exactly one thread ever computes a
+        given overlap's points."""
+        if not self._pipeline_mode:
+            return
+        runs = o.cigar_runs
+        cols = int(runs[0].sum()) if runs is not None else 0
+        with self._stream_lock:
+            self._decode_buf.append(o)
+            self._decode_buf_cols += cols
+            if self._decode_buf_cols < self._decode_col_budget \
+                    and len(self._decode_buf) < 4096:
+                return
+            batch, self._decode_buf = self._decode_buf, []
+            self._decode_buf_cols = 0
+        self._decode_futs.append(
+            self._pool.submit(self._finish_overlap_batch, batch))
+
+    def _stream_decode_flush(self) -> None:
+        """Submit whatever the decode buffer holds (called at consume
+        -chunk boundaries so decode overlaps the next device chunk)."""
+        if not self._pipeline_mode:
+            return
+        with self._stream_lock:
+            batch, self._decode_buf = self._decode_buf, []
+            self._decode_buf_cols = 0
+        if batch:
             self._decode_futs.append(
-                self._pool.submit(self._finish_overlap, o))
+                self._pool.submit(self._finish_overlap_batch, batch))
 
     def _drain_stream_decodes(self) -> None:
+        self._stream_decode_flush()
         for f in self._decode_futs:
-            f.result()   # _finish_overlap never raises; this is a join
+            f.result()   # batch tasks never raise; this is a join
         self._decode_futs = []
 
     def _mark_align_device_free(self) -> None:
@@ -684,13 +743,21 @@ class TPUPolisher(Polisher):
             r_dev, r_cpu, r_src = calibrate.get_rates(
                 "poa", n_dev, self.POA_DEV_US_PER_UNIT,
                 self.POA_CPU_US_PER_UNIT)
+            # price the CPU tail over the RESERVED-down worker count:
+            # the host also runs the data plane (decode, routing,
+            # stitching), so a full-worker rate overstated the tail
+            # and capped the device share (no-op under env-pinned
+            # rates, keeping golden configs byte-stable)
+            n_priced = calibrate.host_reserved_workers(n_workers,
+                                                       r_src)
             dev_left = _rate_split(
                 [unit_of[i] * r_dev / n_dev for i in eligible],
-                [unit_of[i] * r_cpu / n_workers for i in eligible])
+                [unit_of[i] * r_cpu / n_priced for i in eligible])
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::polish] poa split: device "
                 f"{dev_left}/{len(eligible)} windows "
-                f"({r_src} rates {r_dev:.2f}/{r_cpu:.2f})")
+                f"({r_src} rates {r_dev:.2f}/{r_cpu:.2f}, "
+                f"{n_priced}/{n_workers} cpu workers priced)")
 
         # split observability (bench: poa_split_detail): the decision
         # inputs that produced this cut, so a capped device share is
@@ -716,6 +783,8 @@ class TPUPolisher(Polisher):
             "rate_cpu_us_per_unit": round(sd_cpu, 4),
             "rate_source": sd_src,
             "n_dev": n_dev, "n_cpu_workers": n_workers,
+            "n_cpu_workers_priced": calibrate.host_reserved_workers(
+                n_workers, sd_src),
             "cut": int(dev_left), "n_eligible": len(eligible),
             "dev_unit_share": round(sum(units[:dev_left]) / total_u, 4),
             "unit_total": round(total_u, 1),
@@ -1047,7 +1116,10 @@ class TPUPolisher(Polisher):
     def _device_align_overlaps(self, overlaps: List[Overlap]) -> None:
         pending = []  # (dim, overlap), dim = max span side
         for o in overlaps:
-            if o.cigar or o.breaking_points is not None:
+            # SAM-ingested overlaps arrive with cigar_runs (no string
+            # round trip since r7) and must not be re-aligned
+            if o.cigar or o.cigar_runs is not None \
+                    or o.breaking_points is not None:
                 continue
             lq = o.q_end - o.q_begin
             lt = o.t_end - o.t_begin
@@ -1492,6 +1564,7 @@ class TPUPolisher(Polisher):
                         tally["cert"] += 1
                     else:
                         still.add(i)
+                self._stream_decode_flush()
 
             align_pallas.run_pipelined(chunks, dispatch, consume,
                                        depth)
@@ -1590,6 +1663,7 @@ class TPUPolisher(Polisher):
                         tally["cert"] += 1
                     else:
                         still.add(i)
+                self._stream_decode_flush()
 
             align_pallas.run_pipelined(chunks, dispatch, consume,
                                        depth)
@@ -1736,3 +1810,4 @@ class TPUPolisher(Polisher):
                 # while the next chunk owns the device, advancing the
                 # streaming ledger (no-op when the pipeline is off)
                 self._stream_decode(o)
+        self._stream_decode_flush()

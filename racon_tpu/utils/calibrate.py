@@ -102,6 +102,34 @@ def get_rates(stage: str, n_dev: int, default_dev: float,
     return out
 
 
+def host_reserved_workers(n_workers: int, source: str) -> int:
+    """Effective CPU worker count for pricing a hybrid split.
+
+    The rate model used to price the CPU tail as if all
+    ``num_threads - 1`` workers were dedicated to it, but the host
+    also runs the data plane concurrently (batched breaking-point
+    decode, window routing, stitching) — so the honest CPU rate is
+    over a RESERVED-down worker count, which shifts the argmin toward
+    the device (ISSUE r7: re-price the POA split with the new host
+    rates).  RACON_TPU_POA_HOST_RESERVE (default 0.25, clamped to
+    [0, 0.9]) is the reserved fraction; a static knob, never a
+    measured time, so the split stays a pure function of the input.
+
+    When ``source`` is "env" the rates are pinned (golden CI
+    configs): the split must stay exactly what those pins encode, so
+    the worker count passes through unchanged."""
+    if source == "env" or n_workers <= 0:
+        return n_workers
+    try:
+        reserve = float(os.environ.get(
+            "RACON_TPU_POA_HOST_RESERVE", "0.25"))
+    except ValueError:
+        reserve = 0.25
+    reserve = min(max(reserve, 0.0), 0.9)
+    import math
+    return max(1, n_workers - math.ceil(n_workers * reserve))
+
+
 def predict_walls(align_s: float, poa_s: float,
                   overlap_s: float = None) -> dict:
     """Overlap-aware wall predictor for the two-stage polish.

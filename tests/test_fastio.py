@@ -1,0 +1,325 @@
+"""Fast-io scan parsers (ISSUE r7): byte-exact equivalence with the
+line parsers.
+
+The RACON_TPU_FAST_IO path (racon_tpu/io/fastio.py) replaces the
+per-line Python parse loops with numpy scans over a whole-file buffer.
+Its contract is strict: the SAME record stream, the SAME chunk
+boundaries for any byte budget, and the SAME error text as the line
+parsers — these tests pin all three over edge-case inputs (CRLF,
+multi-line FASTA, wrapped/empty quality, truncated final records,
+blank lines, malformed rows, gzip) plus seeded fuzz, and pin the
+batched breaking-point decode (core/overlap.py) against the
+single-overlap walk.
+"""
+
+import gzip
+import os
+import random
+
+import numpy as np
+import pytest
+
+from racon_tpu.io import fastio as F
+from racon_tpu.io import parsers as P
+
+
+def _write(tmp_path, name, data):
+    p = str(tmp_path / name)
+    if name.endswith(".gz"):
+        with gzip.open(p, "wb") as f:
+            f.write(data)
+    else:
+        with open(p, "wb") as f:
+            f.write(data)
+    return p
+
+
+def _drain(parser, budget):
+    out, rounds = [], 0
+    while parser.parse(out, budget):
+        rounds += 1
+        assert rounds < 10000
+    return out, rounds
+
+
+def _assert_sequences_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert (x.name, x.data, x.quality) == (y.name, y.data, y.quality)
+
+
+def _assert_overlaps_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        for attr in ("q_name", "t_name", "q_begin", "q_end", "q_length",
+                     "t_begin", "t_end", "t_length", "strand", "error",
+                     "length", "is_valid", "cigar"):
+            assert getattr(x, attr) == getattr(y, attr), attr
+        assert (x.cigar_runs is None) == (y.cigar_runs is None)
+        if x.cigar_runs is not None:
+            assert np.array_equal(x.cigar_runs[0], y.cigar_runs[0])
+            assert np.array_equal(x.cigar_runs[1], y.cigar_runs[1])
+
+
+def _check_equivalent(path, line_cls, scan_cls, check, budgets):
+    for budget in budgets:
+        lp, sp = line_cls(path), scan_cls(path)
+        try:
+            want_exc = None
+            want, want_rounds = _drain(lp, budget)
+        except (ValueError, OverflowError) as exc:
+            want_exc = (type(exc).__name__, str(exc))
+        if want_exc is None:
+            got, got_rounds = _drain(sp, budget)
+            check(want, got)
+            assert want_rounds == got_rounds, (path, budget)
+        else:
+            with pytest.raises((ValueError, OverflowError)) as ei:
+                _drain(sp, budget)
+            assert (type(ei.value).__name__, str(ei.value)) == want_exc
+        lp.close()
+        sp.close()
+
+
+FASTA_CASES = [
+    b">a desc\nACGT\n",
+    b">a\nAC\nGT\nTT\n>b\n\n>c x\nGGGG",        # multi-line, no final \n
+    b"junk\n>a\nacgt\n>b two words\nNNNN\n",    # prelude junk, lowercase
+    b">a\r\nAC\r\nGT\r\n>b\r\nTT\r\n",          # CRLF
+    b">only_header\n",
+    b">a\nACGT",                                # truncated final record
+]
+
+FASTQ_CASES = [
+    b"@a d\nACGT\n+\nIIII\n",
+    b"@a\nAC\nGT\n+x\nII\nII\n@b\nTTTT\n+\n!!!!\n",  # wrapped + dummy q
+    b"@a\r\nACGT\r\n+\r\nIIII\r\n",
+    b"junk\n@a\nAC\n+\nII\n",
+    b"@a\nACGT\n+\nII",                         # truncated quality
+    b"@a\nACGT\n+\n",                           # empty quality at EOF
+]
+
+PAF_CASES = [
+    b"q1\t100\t5\t95\t+\tt1\t200\t10\t190\t90\t100\t60\n",
+    b"q1\t100\t5\t95\t-\tt2\t200\t10\t190\n",
+    b"\n\nq1\t100\t5\t95\t+\tt1\t200\t10\t190\n\n",  # blank lines
+    b"q1\t100\t5\t95\t*\tt1\t200\t10\t190",          # odd strand, no \n
+    b"q1\t100\t005\t95\t+\tt1\t200\t10\t190\n",      # leading zeros
+    b"q1\t100\t 5\t95\t+\tt1\t200\t10\t190\n",       # int() whitespace
+    b"q1\t123456789012345678901\t5\t95\t+\tt1\t200\t10\t190\n",
+]
+
+PAF_ERROR_CASES = [
+    b"q1\t100\t5\t95\t+\tt1\t200\t10\n",             # missing column
+    b"q1\t100\txx\t95\t+\tt1\t200\t10\t190\n",       # non-numeric
+    b"q\xff\t100\t5\t95\t+\tt1\t200\t10\t190\n",     # invalid utf-8
+]
+
+MHAP_CASES = [
+    b"0 1 0.05 0.9 0 5 95 100 0 10 190 200\n",
+    b"3   7\t0.1 0.2\t1 0 50 60 0 5 55 70\n",        # mixed whitespace
+    b"0 1 0.05 0.9 0 5 95 100 1 10 190 200 extra\n",
+]
+
+SAM_CASES = [
+    b"@HD\tVN:1.6\n@SQ\tSN:t\tLN:9\n"
+    b"q1\t0\tt1\t11\t60\t4S20M5I3D2S\t*\t0\t0\tACGT\tIIII\n",
+    b"q1\t16\tt1\t11\t60\t4S20M5I3D2S\t*\t0\t0\tACGT\tIIII\n",
+    b"q1\t4\t*\t0\t0\t*\t*\t0\t0\tACGT\tIIII\n",     # unmapped
+    b"q1\t0\tt1\t11\t60\t20M10X5=3N2H\n",            # exotic ops
+    b"q1\t0\tt1\t11\t60\t123456789012345678901M\n",  # >18-digit run
+]
+
+
+@pytest.mark.parametrize("data", FASTA_CASES)
+def test_fasta_scan_equivalent(tmp_path, data):
+    for ext in ("fasta", "fasta.gz"):
+        p = _write(tmp_path, f"case.{ext}", data)
+        _check_equivalent(p, P.FastaParser, F.FastaScanParser,
+                          _assert_sequences_equal, (-1, 1, 7, 10 ** 9))
+
+
+@pytest.mark.parametrize("data", FASTQ_CASES)
+def test_fastq_scan_equivalent(tmp_path, data):
+    for ext in ("fastq", "fastq.gz"):
+        p = _write(tmp_path, f"case.{ext}", data)
+        _check_equivalent(p, P.FastqParser, F.FastqScanParser,
+                          _assert_sequences_equal, (-1, 1, 9, 10 ** 9))
+
+
+@pytest.mark.parametrize("data", PAF_CASES + PAF_ERROR_CASES)
+def test_paf_scan_equivalent(tmp_path, data):
+    p = _write(tmp_path, "case.paf", data)
+    _check_equivalent(p, P.PafParser, F.PafScanParser,
+                      _assert_overlaps_equal, (-1, 1, 25, 10 ** 9))
+
+
+@pytest.mark.parametrize("data", MHAP_CASES)
+def test_mhap_scan_equivalent(tmp_path, data):
+    p = _write(tmp_path, "case.mhap", data)
+    _check_equivalent(p, P.MhapParser, F.MhapScanParser,
+                      _assert_overlaps_equal, (-1, 1, 25, 10 ** 9))
+
+
+@pytest.mark.parametrize("data", SAM_CASES)
+def test_sam_scan_equivalent(tmp_path, data):
+    p = _write(tmp_path, "case.sam", data)
+    _check_equivalent(p, P.SamParser, F.SamScanParser,
+                      _assert_overlaps_equal, (-1, 1, 25, 10 ** 9))
+
+
+def test_sam_missing_alignment_raises_invalid_input(tmp_path):
+    from racon_tpu.core.overlap import InvalidInputError
+
+    p = _write(tmp_path, "bad.sam",
+               b"q1\t0\tt1\t11\t60\t*\t*\t0\t0\tACGT\tIIII\n")
+    with pytest.raises(InvalidInputError):
+        F.SamScanParser(p).parse([], -1)
+    with pytest.raises(InvalidInputError):
+        P.SamParser(p).parse([], -1)
+
+
+def test_fasta_fuzz_random_layouts(tmp_path):
+    rng = random.Random(7)
+    for trial in range(25):
+        parts = []
+        for r in range(rng.randrange(0, 8)):
+            nl = b"\r\n" if rng.random() < 0.3 else b"\n"
+            parts.append(b">" + f"r{trial}_{r} d".encode() + nl)
+            for _ in range(rng.randrange(0, 4)):
+                parts.append(bytes(
+                    rng.choice(b"ACGTacgtn")
+                    for _ in range(rng.randrange(0, 30))) + nl)
+        data = b"".join(parts)
+        if rng.random() < 0.3 and data.endswith(b"\n"):
+            data = data[:-1]
+        p = _write(tmp_path, f"fuzz{trial}.fasta", data)
+        _check_equivalent(p, P.FastaParser, F.FastaScanParser,
+                          _assert_sequences_equal,
+                          (-1, rng.randrange(1, 60)))
+
+
+def test_factory_selects_scan_parsers(tmp_path, monkeypatch):
+    p = _write(tmp_path, "x.fasta", b">a\nACGT\n")
+    q = _write(tmp_path, "x.paf",
+               b"q1\t100\t5\t95\t+\tt1\t200\t10\t190\n")
+    monkeypatch.delenv("RACON_TPU_FAST_IO", raising=False)
+    assert isinstance(P.create_sequence_parser(p), F.FastaScanParser)
+    assert isinstance(P.create_overlap_parser(q), F.PafScanParser)
+    monkeypatch.setenv("RACON_TPU_FAST_IO", "0")
+    assert isinstance(P.create_sequence_parser(p), P.FastaParser)
+    assert isinstance(P.create_overlap_parser(q), P.PafParser)
+
+
+def test_batched_cigar_parse_matches_regex():
+    from racon_tpu.core.overlap import _CIGAR_RE, _OPS, \
+        parse_cigar_runs_batch
+
+    cigars = [b"4S20M5I3D2S", b"*", b"", b"12*34M", b"1 2M", b"007M",
+              b"20M10X5=3N2H6P", b"999999999999999999M",
+              b"12345678901234567890M", b"M5", b"5"]
+    buf = b"\t".join(cigars)
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    starts, ends, pos = [], [], 0
+    for c in cigars:
+        starts.append(pos)
+        ends.append(pos + len(c))
+        pos += len(c) + 1
+    runs, bad = parse_cigar_runs_batch(
+        arr, np.array(starts, np.int64), np.array(ends, np.int64))
+    for i, c in enumerate(cigars):
+        if bad[i]:
+            continue   # >18-digit rows defer to the regex fallback
+        ops = _CIGAR_RE.findall(c)
+        assert runs[i][0].tolist() == [int(n) for n, _ in ops]
+        assert runs[i][1].tolist() == [_OPS.index(op) for _, op in ops]
+    assert bad[cigars.index(b"12345678901234567890M")]
+
+
+def _random_sam_overlap(rng):
+    from racon_tpu.core.overlap import Overlap
+
+    n_runs = rng.randrange(1, 40)
+    ops = []
+    for _ in range(n_runs):
+        ops.append(f"{rng.randrange(1, 120)}"
+                   f"{rng.choice('MIDNSHP=X')}")
+    cigar = "".join(ops).encode()
+    flag = rng.choice((0, 16))
+    o = Overlap.from_sam_bytes("q", flag, "t", rng.randrange(1, 500),
+                               cigar)
+    o.t_length = o.t_end + rng.randrange(0, 100)
+    return o
+
+
+def test_batched_breaking_point_decode_matches_single():
+    from racon_tpu.core.overlap import (Overlap,
+                                        decode_breaking_points_batch)
+
+    rng = random.Random(11)
+    overlaps = [_random_sam_overlap(rng) for _ in range(120)]
+    singles = []
+    for o in overlaps:
+        ref = Overlap.from_sam_bytes(o.q_name, 16 if o.strand else 0,
+                                     o.t_name, o.t_begin + 1, b"1M")
+        # clone the geometry + runs, then walk the single-overlap path
+        for attr in ("q_begin", "q_end", "q_length", "t_begin",
+                     "t_end", "t_length"):
+            setattr(ref, attr, getattr(o, attr))
+        ref.cigar_runs = o.cigar_runs
+        ref.breaking_points = None
+        ref.find_breaking_points_from_cigar(100)
+        singles.append(ref.breaking_points)
+    # tiny column budget forces many slabs: slab boundaries must not
+    # leak state between overlaps
+    decode_breaking_points_batch(overlaps, 100, col_budget=700)
+    for o, want in zip(overlaps, singles):
+        assert o.breaking_points is not None
+        assert np.array_equal(o.breaking_points, want)
+        assert o.cigar_runs is None
+
+
+def test_polish_bytes_identical_fast_io_on_off(tmp_path, monkeypatch):
+    """End-to-end: a CPU polish under the scan parsers emits the same
+    FASTA bytes as under the line parsers (satellite c)."""
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.tools import simulate
+
+    reads, paf, draft = simulate.simulate(
+        str(tmp_path), genome_len=8_000, coverage=6, read_len=800,
+        seed=21)
+
+    def polish():
+        pol = create_polisher(reads, paf, draft, PolisherType.kC, 500,
+                              10.0, 0.3, True, 5, -4, -8,
+                              num_threads=4)
+        pol.initialize()
+        out = pol.polish(True)
+        pol.close()
+        return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                        for s in out)
+
+    monkeypatch.setenv("RACON_TPU_FAST_IO", "1")
+    fast = polish()
+    monkeypatch.setenv("RACON_TPU_FAST_IO", "0")
+    slow = polish()
+    assert fast == slow
+
+
+def test_host_metrics_recorded(tmp_path, monkeypatch):
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.tools import simulate
+
+    reads, paf, draft = simulate.simulate(
+        str(tmp_path), genome_len=6_000, coverage=5, read_len=600,
+        seed=22)
+    pol = create_polisher(reads, paf, draft, PolisherType.kC, 500,
+                          10.0, 0.3, True, 5, -4, -8, num_threads=2)
+    pol.initialize()
+    pol.polish(True)
+    m = pol.metrics
+    assert m.value("host.parse_s") > 0
+    assert m.value("host.stitch_s") >= 0
+    assert m.value("host.stage_s") >= m.value("host.parse_s")
+    assert 0.0 <= m.value("host.share") <= 1.0
+    pol.close()
