@@ -416,7 +416,7 @@ def main():
             log(f"[bench] mega_ont bench skipped "
                 f"({type(exc).__name__}: {exc})")
 
-    print(json.dumps({
+    record = {
         "metric": "sample_e2e_polish_wall_s",
         "value": round(accel_wall, 3),
         "unit": "s",
@@ -425,17 +425,35 @@ def main():
         "edit_distance": int(accel_dist),
         "cpu_edit_distance": int(cpu_dist),
         **extra,
-    }))
+    }
+    print(json.dumps(record))
     sys.stdout.flush()
     sys.stderr.flush()
+    rc = 0
     if not extra.get("deterministic", True):
         # a nondeterministic TPU path is a regression, not a footnote
         # (the reference diffs full output byte-for-byte in CI,
         # ci/gpu/cuda_test.sh:33) -- fail the bench run
-        os._exit(1)
+        rc = 1
+    elif os.environ.get("RACON_TPU_BENCH_GATE"):
+        # opt-in regression gate against the committed trajectory;
+        # a subprocess so a gate bug can never eat the JSON line
+        import subprocess
+        import tempfile
+        gate = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "ci", "common", "bench_gate.py")
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".json", delete=False) as f:
+            json.dump(record, f)
+        try:
+            rc = subprocess.run(
+                [sys.executable, gate, f.name]).returncode
+        finally:
+            os.unlink(f.name)
+        sys.stderr.flush()
     # hard-exit: the JSON line above is the contract, and background
     # prewarm compiles must not stall (or abort) interpreter teardown
-    os._exit(0)
+    os._exit(rc)
 
 
 def scale_bench():

@@ -1,0 +1,219 @@
+#!/usr/bin/env python3
+"""Bench regression gate: fresh bench JSON vs the BENCH_r*.json trajectory.
+
+The repo commits one bench record per PR round (BENCH_r01.json ...),
+each either the driver wrapper ``{"parsed": {...}}`` or the bare
+record bench.py printed.  This gate compares a fresh record against
+the committed trajectory and exits non-zero — with a readable delta
+table — when a watched metric regressed past its threshold:
+
+* **wall metrics** (lower is better): fail when the fresh wall
+  exceeds the reference by more than ``--wall-tol`` (default 20%,
+  matching the host-jitter slack bench.py itself budgets).
+* **quality metrics** (edit distances, lower is better): fail past
+  10% relative AND an absolute slack of 10 edits (small counts
+  jitter by a handful of edits between hosts).
+* **share metrics** (higher is better, 0..1): fail when the device
+  window share drops more than 0.10 absolute.
+* ``deterministic: false`` in the fresh record fails outright.
+
+The reference value for each metric is the **median of the newest
+three** trajectory records that carry it — one outlier round cannot
+poison the gate, and newly added metrics gate as soon as one round
+recorded them.  Metrics missing from the fresh record (a budget-
+trimmed bench leg) or from the whole trajectory are skipped, and a
+carried-forward CPU leg (``*_cpu_wall_provenance``) never gates.
+
+Usage::
+
+    ci/common/bench_gate.py FRESH.json [--trajectory DIR]
+        [--wall-tol 0.20] [--dist-tol 0.10] [--share-tol 0.10]
+
+Wired into ``bench.py`` behind ``RACON_TPU_BENCH_GATE=1``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+#: wall-clock legs, seconds, lower is better (relative threshold)
+WALL_METRICS = (
+    "value",                 # the headline polish wall
+    "scale_tpu_wall_s",
+    "mega_tpu_wall_s",
+    "mega_ont_tpu_wall_s",
+    "w1000_wall_s",
+    "banded_wall_s",
+)
+
+#: quality legs, edit distance, lower is better
+DIST_METRICS = (
+    "edit_distance",
+    "banded_edit_distance",
+    "scale_tpu_edit_distance",
+    "mega_tpu_edit_distance",
+    "mega_ont_tpu_edit_distance",
+    "w1000_edit_distance",
+)
+
+#: device window share, 0..1, higher is better (absolute threshold)
+SHARE_METRICS = (
+    "mega_device_window_share",
+    "mega_ont_device_window_share",
+)
+
+#: absolute slack for edit-distance drift on top of the relative tol
+DIST_ABS_SLACK = 10.0
+
+
+def parsed_record(doc: dict):
+    """Driver wrapper or bare bench record -> the bench dict."""
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    if isinstance(doc, dict) and "value" in doc:
+        return doc
+    return None
+
+
+def load_trajectory(directory: str) -> list:
+    """Committed BENCH records, oldest first."""
+    records = []
+    for path in sorted(glob.glob(
+            os.path.join(directory, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = parsed_record(json.load(f))
+        except (OSError, ValueError):
+            continue
+        if rec is not None:
+            records.append((os.path.basename(path), rec))
+    return records
+
+
+def reference_value(trajectory: list, key: str):
+    """Median of the newest <=3 records carrying ``key``."""
+    vals = [rec[key] for _, rec in trajectory
+            if isinstance(rec.get(key), (int, float))][-3:]
+    if not vals:
+        return None
+    vals = sorted(float(v) for v in vals)
+    return vals[len(vals) // 2]
+
+
+def check(fresh: dict, trajectory: list, wall_tol: float,
+          dist_tol: float, share_tol: float) -> list:
+    """All gated comparisons.  Returns a list of row dicts; rows with
+    ``fail: True`` are regressions."""
+    rows = []
+
+    def row(key, kind, ref, new, fail, note):
+        rows.append({"metric": key, "kind": kind, "ref": ref,
+                     "new": new, "fail": fail, "note": note})
+
+    if fresh.get("deterministic") is False:
+        row("deterministic", "bool", True, False, True,
+            "two identical runs produced different bytes")
+
+    for key in WALL_METRICS:
+        new = fresh.get(key)
+        ref = reference_value(trajectory, key)
+        if not isinstance(new, (int, float)) or ref is None or ref <= 0:
+            continue
+        ratio = float(new) / ref
+        row(key, "wall", ref, float(new), ratio > 1.0 + wall_tol,
+            f"{(ratio - 1.0) * 100:+.1f}% vs tol +{wall_tol * 100:.0f}%")
+
+    for key in DIST_METRICS:
+        new = fresh.get(key)
+        ref = reference_value(trajectory, key)
+        if not isinstance(new, (int, float)) or ref is None:
+            continue
+        delta = float(new) - ref
+        limit = max(ref * dist_tol, DIST_ABS_SLACK)
+        row(key, "dist", ref, float(new), delta > limit,
+            f"{delta:+.0f} vs tol +{limit:.0f}")
+
+    for key in SHARE_METRICS:
+        new = fresh.get(key)
+        ref = reference_value(trajectory, key)
+        if not isinstance(new, (int, float)) or ref is None:
+            continue
+        delta = float(new) - ref
+        row(key, "share", ref, float(new), delta < -share_tol,
+            f"{delta:+.3f} vs tol -{share_tol:.2f}")
+
+    return rows
+
+
+def format_table(rows: list) -> str:
+    lines = [f"{'metric':<30s} {'kind':<6s} {'ref':>12s} "
+             f"{'new':>12s}  {'delta':<24s} verdict"]
+    for r in rows:
+        ref = f"{r['ref']:.4g}" if isinstance(r['ref'], float) \
+            else str(r['ref'])
+        new = f"{r['new']:.4g}" if isinstance(r['new'], float) \
+            else str(r['new'])
+        verdict = "REGRESSED" if r["fail"] else "ok"
+        lines.append(f"{r['metric']:<30s} {r['kind']:<6s} {ref:>12s} "
+                     f"{new:>12s}  {r['note']:<24s} {verdict}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Gate a fresh bench JSON against the committed "
+        "BENCH_r*.json trajectory.")
+    ap.add_argument("fresh", help="fresh bench JSON (driver-wrapped "
+                    "or bare bench.py record)")
+    ap.add_argument("--trajectory", default=None,
+                    help="directory holding BENCH_r*.json "
+                    "(default: the repo root, next to this script)")
+    ap.add_argument("--wall-tol", type=float, default=0.20)
+    ap.add_argument("--dist-tol", type=float, default=0.10)
+    ap.add_argument("--share-tol", type=float, default=0.10)
+    args = ap.parse_args(argv)
+
+    directory = args.trajectory or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    try:
+        with open(args.fresh) as f:
+            fresh = parsed_record(json.load(f))
+    except (OSError, ValueError) as exc:
+        print(f"[bench_gate] cannot read fresh record: {exc}",
+              file=sys.stderr)
+        return 2
+    if fresh is None:
+        print("[bench_gate] fresh record carries no bench payload",
+              file=sys.stderr)
+        return 2
+
+    trajectory = load_trajectory(directory)
+    if not trajectory:
+        # first round of a new checkout: nothing to gate against is
+        # a pass, not a failure
+        print(f"[bench_gate] no BENCH_r*.json under {directory}; "
+              f"nothing to gate", file=sys.stderr)
+        return 0
+
+    rows = check(fresh, trajectory, args.wall_tol, args.dist_tol,
+                 args.share_tol)
+    names = ", ".join(n for n, _ in trajectory[-3:])
+    print(f"[bench_gate] reference: median of newest <=3 of "
+          f"{len(trajectory)} record(s) ({names})", file=sys.stderr)
+    print(format_table(rows), file=sys.stderr)
+    failed = [r for r in rows if r["fail"]]
+    if failed:
+        print(f"[bench_gate] FAIL: {len(failed)} metric(s) regressed",
+              file=sys.stderr)
+        return 1
+    print(f"[bench_gate] pass: {len(rows)} metric(s) within "
+          f"thresholds", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

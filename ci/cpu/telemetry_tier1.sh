@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Telemetry-lane tier-1 (ISSUE 8 CI satellite): boots the polishing
+# daemon with the utilization sampler ON and runs the telemetry
+# suite — Prometheus exposition round-trip + percentile math, the
+# metrics/health/watch protocol ops scraped mid-job against a live
+# daemon, `racon-tpu top --once --json` machine mode, the bench
+# regression gate, and the pinned guarantee that a served job with
+# the sampler running stays byte-identical to the one-shot CLI —
+# with the same hardening as the serve lane:
+#   * JAX_PLATFORMS=cpu + 8 virtual devices (tests/conftest.py)
+#     exercises the sharded dispatch path without hardware;
+#   * PYTHONDEVMODE=1 surfaces unclosed sockets/files and unjoined
+#     threads in the sampler/watch-stream handlers;
+#   * pytest's faulthandler timeout dumps EVERY thread's traceback
+#     if a test hangs, so a stuck watch stream or sampler shows up
+#     as a stack dump naming the blocked wait instead of an opaque
+#     CI timeout.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export PYTHONDEVMODE=1
+python -m pytest tests/test_telemetry.py -q \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"

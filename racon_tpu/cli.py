@@ -28,13 +28,17 @@ from racon_tpu.io.parsers import (MalformedInputError,
 USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequences>
        racon-tpu serve --socket PATH [options ...]
        racon-tpu submit --socket PATH [options ...] <sequences> <overlaps> <target sequences>
-       racon-tpu status --socket PATH
+       racon-tpu status --socket PATH [--json]
+       racon-tpu top --socket PATH [--interval S] [--once] [--json]
 
     subcommands (racon_tpu/serve — persistent polishing service):
         serve    start the warm-kernel job daemon on a unix socket
         submit   run one polish through a daemon (same options and
                  stdout contract as the one-shot form)
         status   print a daemon's queue/registry/provenance snapshot
+                 (--json for the raw document)
+        top      live telemetry view over the daemon's watch stream
+                 (--once --json for one machine-readable frame)
 
 
     #default output is stdout
@@ -239,6 +243,9 @@ def main(argv=None):
     if argv and argv[0] == "status":
         from racon_tpu.serve import client as serve_client
         raise SystemExit(serve_client.main_status(argv[1:]))
+    if argv and argv[0] == "top":
+        from racon_tpu.serve import top as serve_top
+        raise SystemExit(serve_top.main(argv[1:]))
     try:
         opts, inputs = parse_args(argv)
     except ValueError as exc:

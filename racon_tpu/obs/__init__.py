@@ -37,11 +37,14 @@ ci/cpu/obs_tier1.sh and tests/test_obs.py fails on raw
 
 from __future__ import annotations
 
-from racon_tpu.obs.metrics import REGISTRY, MetricAttr, Registry
+from racon_tpu.obs.devutil import DEVICE_UTIL, DeviceUtil
+from racon_tpu.obs.metrics import (HIST_BUCKETS, REGISTRY, MetricAttr,
+                                   Registry, hist_quantile)
 from racon_tpu.obs.trace import (TRACER, device_span, enable_trace, now,
                                  span, write_trace)
 
 __all__ = [
     "REGISTRY", "Registry", "MetricAttr", "TRACER",
+    "HIST_BUCKETS", "hist_quantile", "DEVICE_UTIL", "DeviceUtil",
     "now", "span", "device_span", "enable_trace", "write_trace",
 ]

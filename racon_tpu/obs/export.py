@@ -1,0 +1,184 @@
+"""Registry export: Prometheus text exposition + JSON snapshots.
+
+The PR 4 registry made every run's counters readable in-process; a
+persistent daemon (racon_tpu/serve) needs them readable from the
+OUTSIDE — a scraper, the ``racon-tpu top`` client, the future fleet
+router.  This module renders a :class:`racon_tpu.obs.metrics.Registry`
+snapshot two ways:
+
+* :func:`prometheus_text` — Prometheus text exposition (format 0.0.4):
+  counters/gauges as single samples, bucketed histograms as cumulative
+  ``_bucket{le="..."}`` series + ``_sum``/``_count``, all under the
+  ``racon_tpu_`` prefix.  Registry names are free-form (dots, rung
+  suffixes like ``align_rung_admit.wfa2048``); :func:`sanitize` maps
+  them onto the Prometheus grammar deterministically.
+* :func:`json_snapshot` — the raw snapshot with per-histogram
+  p50/p90/p99 attached, for machine consumers that want numbers
+  without a Prometheus parser.
+* :func:`parse_prometheus_text` — a minimal exposition parser used by
+  the round-trip tests (and any Python-side scraper): recovers the
+  counters/gauges/histograms keyed by their sanitized names.
+
+Nothing here writes the registry: export renders what already
+happened (determinism contract, racon_tpu/obs/__init__.py).
+"""
+
+from __future__ import annotations
+
+import re
+
+from racon_tpu.obs.metrics import HIST_BUCKETS, hist_quantile
+
+PREFIX = "racon_tpu_"
+
+_INVALID = re.compile(r"[^a-zA-Z0-9_]")
+
+#: quantiles attached to every exported histogram
+QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
+
+
+def sanitize(name: str) -> str:
+    """Registry name -> Prometheus metric name (prefixed, every
+    character outside ``[a-zA-Z0-9_]`` folded to ``_``).  The mapping
+    is deterministic but not injective — two registry names that
+    differ only in punctuation collide, which the free-form registry
+    namespace never produces in practice."""
+    san = _INVALID.sub("_", name)
+    # the reject-code names carry a leading '-' ("poa_reject.-1");
+    # folding gives a double underscore, which is legal — but a name
+    # must not START with a digit after the prefix is applied, and
+    # the prefix guarantees that
+    return PREFIX + san
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Render a registry snapshot (``Registry.snapshot()``) as
+    Prometheus text exposition."""
+    lines = []
+    for name in sorted(snapshot.get("counters", {})):
+        mn = sanitize(name)
+        lines.append(f"# TYPE {mn} counter")
+        lines.append(f"{mn} {_fmt(snapshot['counters'][name])}")
+    for name in sorted(snapshot.get("gauges", {})):
+        mn = sanitize(name)
+        v = snapshot["gauges"][name]
+        if isinstance(v, bool):
+            v = int(v)
+        if not isinstance(v, (int, float)):
+            continue   # non-numeric gauges have no exposition form
+        lines.append(f"# TYPE {mn} gauge")
+        lines.append(f"{mn} {_fmt(v)}")
+    for name in sorted(snapshot.get("histograms", {})):
+        h = snapshot["histograms"][name]
+        mn = sanitize(name)
+        lines.append(f"# TYPE {mn} histogram")
+        counts = {int(k): v for k, v in h.get("buckets", {}).items()}
+        cum = 0
+        for idx in sorted(counts):
+            cum += counts[idx]
+            le = _fmt(HIST_BUCKETS[idx]) if idx < len(HIST_BUCKETS) \
+                else "+Inf"
+            if le != "+Inf":
+                lines.append(f'{mn}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{mn}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{mn}_sum {_fmt(h['sum'])}")
+        lines.append(f"{mn}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{le="(?P<le>[^"]+)"\})?\s+(?P<value>\S+)$')
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Parse :func:`prometheus_text` output back into
+    ``{"counters": .., "gauges": .., "histograms": ..}`` keyed by the
+    SANITIZED metric names.  Histograms come back as ``{"count", "sum",
+    "buckets": {le_string: cumulative_count}}``.  Raises ValueError on
+    a malformed line — the round-trip test doubles as a format
+    validator."""
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    types = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if not m:
+            raise ValueError(f"malformed exposition line: {line!r}")
+        name, le, value = m.group("name", "le", "value")
+        value = float(value)
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and \
+                    types.get(name[:-len(suffix)]) == "histogram":
+                base = name[:-len(suffix)]
+                break
+        kind = types.get(base)
+        if kind == "histogram":
+            h = out["histograms"].setdefault(
+                base, {"count": 0, "sum": 0.0, "buckets": {}})
+            if name.endswith("_bucket"):
+                h["buckets"][le] = value
+            elif name.endswith("_sum"):
+                h["sum"] = value
+            elif name.endswith("_count"):
+                h["count"] = int(value)
+            else:
+                raise ValueError(f"stray histogram sample: {line!r}")
+        elif kind == "counter":
+            out["counters"][name] = value
+        elif kind == "gauge":
+            out["gauges"][name] = value
+        else:
+            raise ValueError(f"sample without a TYPE line: {line!r}")
+    return out
+
+
+def percentiles(hist: dict) -> dict:
+    """p50/p90/p99 (plus min/max/count/sum passthrough) for one
+    histogram snapshot entry."""
+    out = {"count": hist.get("count", 0),
+           "sum": round(hist.get("sum", 0.0), 6)}
+    if out["count"]:
+        out["min"] = hist.get("min")
+        out["max"] = hist.get("max")
+        for label, q in QUANTILES:
+            out[label] = round(hist_quantile(hist, q), 6)
+    return out
+
+
+def json_snapshot(snapshot: dict) -> dict:
+    """Registry snapshot + per-histogram percentiles — the machine
+    twin of :func:`prometheus_text` (the ``metrics`` op's ``snapshot``
+    section and ``top --once --json``)."""
+    return {
+        "counters": dict(snapshot.get("counters", {})),
+        "gauges": dict(snapshot.get("gauges", {})),
+        "histograms": {
+            name: {**h, "percentiles": percentiles(h)}
+            for name, h in snapshot.get("histograms", {}).items()},
+    }
+
+
+def slo_summary(snapshot: dict, prefix: str = "serve_") -> dict:
+    """Percentile summary of every histogram under ``prefix`` — the
+    serving-tier SLO view (queue_wait/exec_wall/e2e_wall/wall error)
+    that ``watch`` frames and ``racon-tpu top`` render."""
+    return {name: percentiles(h)
+            for name, h in snapshot.get("histograms", {}).items()
+            if name.startswith(prefix)}

@@ -14,9 +14,55 @@ registry records what happened, it never decides what happens
 
 from __future__ import annotations
 
+import bisect
 import threading
 from contextlib import contextmanager
 from typing import Dict, Optional
+
+#: fixed log-spaced histogram bucket upper bounds shared by every
+#: histogram: 4 per decade over 1e-4 .. 1e4 (sub-millisecond queue
+#: waits up to multi-hour jobs; dimensionless ratios land in the same
+#: range).  One process-wide ladder — never derived from observed
+#: data — so snapshots from different runs/jobs merge bucket-by-bucket
+#: and the Prometheus exposition (racon_tpu/obs/export.py) keeps a
+#: stable ``le`` label set across processes.  Values past the last
+#: bound go to the implicit +Inf overflow bucket.
+HIST_BUCKETS = tuple(round(10.0 ** (e / 4.0), 10)
+                     for e in range(-16, 17))
+
+
+def hist_quantile(hist: dict, q: float):
+    """Quantile estimate from a bucketed histogram snapshot entry.
+
+    Walks the cumulative bucket counts to the bucket holding the
+    q-quantile observation and log-interpolates inside it; the
+    estimate is clamped to the exact observed ``[min, max]`` (so p0/
+    p100 are exact and a single-observation histogram answers its own
+    value for every q).  Returns ``None`` for an empty histogram."""
+    count = hist.get("count", 0)
+    if not count:
+        return None
+    buckets = hist.get("buckets")
+    lo, hi = hist.get("min", 0.0), hist.get("max", 0.0)
+    if not buckets:
+        # pre-bucket snapshot (or a min/max-only producer): the
+        # bounds are all there is
+        return lo if q <= 0 else hi
+    # bucket keys may be ints (live registry) or strings (a snapshot
+    # that went through JSON)
+    counts = {int(k): v for k, v in buckets.items()}
+    rank = q * count
+    seen = 0.0
+    for idx in sorted(counts):
+        seen += counts[idx]
+        if seen >= rank:
+            b_hi = HIST_BUCKETS[idx] if idx < len(HIST_BUCKETS) \
+                else hi
+            b_lo = HIST_BUCKETS[idx - 1] if idx > 0 else lo
+            est = (b_lo * b_hi) ** 0.5 if b_lo > 0 and b_hi > 0 \
+                else b_hi
+            return min(max(est, lo), hi)
+    return hi
 
 
 class Registry:
@@ -25,7 +71,9 @@ class Registry:
     * ``add(name, v)``    — counter: accumulate (default +1)
     * ``set(name, v)``    — gauge: overwrite
     * ``peak(name, v)``   — gauge: keep the maximum (high-water mark)
-    * ``observe(name, v)``— histogram: count/sum/min/max
+    * ``observe(name, v)``— histogram: count/sum/min/max + fixed
+                            log-spaced buckets (:data:`HIST_BUCKETS`),
+                            so p50/p90/p99 are exportable
     * ``value(name)``     — read a counter or gauge
     * ``timer(name)``     — context manager adding elapsed seconds to
                             the counter ``name``
@@ -70,16 +118,20 @@ class Registry:
             self.parent.peak(name, value)
 
     def observe(self, name: str, value) -> None:
+        v = float(value)
+        # bucket index: first bound >= v; past-the-end = +Inf overflow
+        idx = bisect.bisect_left(HIST_BUCKETS, v)
         with self._lock:
             h = self._hists.get(name)
             if h is None:
                 h = self._hists[name] = {
                     "count": 0, "sum": 0.0,
-                    "min": float(value), "max": float(value)}
+                    "min": v, "max": v, "buckets": {}}
             h["count"] += 1
-            h["sum"] += float(value)
-            h["min"] = min(h["min"], float(value))
-            h["max"] = max(h["max"], float(value))
+            h["sum"] += v
+            h["min"] = min(h["min"], v)
+            h["max"] = max(h["max"], v)
+            h["buckets"][idx] = h["buckets"].get(idx, 0) + 1
         if self.parent is not None:
             self.parent.observe(name, value)
 
@@ -103,11 +155,19 @@ class Registry:
 
     def snapshot(self) -> dict:
         with self._lock:
+            hists = {}
+            for k, v in self._hists.items():
+                h = dict(v)
+                # string bucket keys: the snapshot is JSON round-trip
+                # stable (json would stringify them anyway, and a
+                # reader must not see live-mutating state)
+                h["buckets"] = {str(i): n
+                                for i, n in v["buckets"].items()}
+                hists[k] = h
             return {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
-                "histograms": {k: dict(v)
-                               for k, v in self._hists.items()},
+                "histograms": hists,
             }
 
     def reset(self) -> None:

@@ -64,6 +64,11 @@ KNOWN_KNOBS = {
     "RACON_TPU_SERVE_ALIGN_MBPS": "",
     "RACON_TPU_SERVE_POA_MBPS": "",
     "RACON_TPU_CALIB_FREEZE": "",
+    # serving telemetry (r12): background sampler period for the
+    # queue/device-util gauges (0 = off; read side only, never
+    # control flow), bench regression gate opt-in
+    "RACON_TPU_SERVE_SAMPLE_S": "0",
+    "RACON_TPU_BENCH_GATE": "",
 }
 
 # host-capability probe reference wall (bench.py's budget scaling):
@@ -164,12 +169,15 @@ def metrics_doc(run_registry=None, details=None,
     (racon_tpu/serve/session.py)."""
     from racon_tpu.obs.metrics import REGISTRY
 
+    from racon_tpu.obs.devutil import DEVICE_UTIL
+
     doc = {
         "schema": "racon-tpu-metrics-v1",
         "environment": environment(probe=probe),
         "run": (run_registry.snapshot()
                 if run_registry is not None else None),
         "process": REGISTRY.snapshot(),
+        "device_util": DEVICE_UTIL.snapshot(),
     }
     if details:
         doc["details"] = details

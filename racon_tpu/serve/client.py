@@ -77,6 +77,46 @@ def admin(socket_path: str, op: str, timeout: float = 30.0) -> dict:
     return request(socket_path, {"op": op}, timeout=timeout)
 
 
+def metrics(socket_path: str, timeout: float = 30.0) -> dict:
+    """Full telemetry frame incl. the Prometheus text exposition."""
+    return request(socket_path, {"op": "metrics"}, timeout=timeout)
+
+
+def health(socket_path: str, timeout: float = 30.0) -> dict:
+    """Cheap liveness/readiness document."""
+    return request(socket_path, {"op": "health"}, timeout=timeout)
+
+
+def watch(socket_path: str, interval_s: float = 1.0, count: int = 0,
+          timeout: float = None):
+    """Generator over streamed telemetry frames (the ``watch`` op).
+    Yields one dict per frame; ends when the server sent ``count``
+    frames (0 = unbounded), drained, or the connection dropped.
+    Closing the generator closes the connection."""
+    sock = socket.socket(socket.AF_UNIX)
+    sock.settimeout(timeout)
+    try:
+        try:
+            sock.connect(socket_path)
+        except OSError as exc:
+            raise ServeError(
+                f"cannot reach server at {socket_path} ({exc})"
+            ) from exc
+        try:
+            protocol.send_frame(sock, {"op": "watch",
+                                       "interval_s": interval_s,
+                                       "count": count})
+            while True:
+                frame = protocol.recv_frame(sock)
+                if frame is None:
+                    return
+                yield frame
+        except (OSError, protocol.ProtocolError) as exc:
+            raise ServeError(f"transport failure ({exc})") from exc
+    finally:
+        sock.close()
+
+
 def spec_from_opts(opts: dict, inputs) -> dict:
     """One-shot CLI options -> job spec (racon_tpu/serve/session.py
     resolves omitted keys to the same CLI defaults)."""
@@ -171,8 +211,10 @@ def main_submit(argv) -> int:
 
 def main_status(argv) -> int:
     socket_path, _, rest = _split_serve_flags(argv)
+    as_json = "--json" in rest
+    rest = [a for a in rest if a != "--json"]
     if not socket_path or rest:
-        print("usage: racon-tpu status --socket PATH",
+        print("usage: racon-tpu status --socket PATH [--json]",
               file=sys.stderr)
         return 1
     try:
@@ -180,6 +222,16 @@ def main_status(argv) -> int:
     except ServeError as exc:
         print(f"[racon_tpu::status] error: {exc}", file=sys.stderr)
         return 1
-    json.dump(doc, sys.stdout, indent=1)
-    print()
+    if as_json:
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+        return 0
+    q = doc.get("queue", {})
+    state = ("draining" if doc.get("draining")
+             else "paused" if q.get("paused") else "running")
+    print(f"server      pid {doc.get('pid')} on {doc.get('socket')}")
+    print(f"state       {state}, up {doc.get('uptime_s', 0):.1f}s")
+    print(f"queue       {q.get('queue_depth')}/{q.get('max_queue')} "
+          f"queued, {len(q.get('running', []))}/{q.get('max_jobs')} "
+          f"running, {q.get('completed')} completed")
     return 0

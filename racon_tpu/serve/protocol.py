@@ -8,8 +8,9 @@ Every message in both directions is one frame::
 
 The JSON document is always an object.  Client requests carry an
 ``op`` key (``submit`` / ``status`` / ``pause`` / ``resume`` /
-``shutdown``); server responses carry ``ok`` (bool) and, when
-``ok`` is false, a machine-readable ``error`` object::
+``shutdown`` / ``metrics`` / ``health`` / ``watch``); server
+responses carry ``ok`` (bool) and, when ``ok`` is false, a
+machine-readable ``error`` object::
 
     {"ok": false,
      "error": {"code": "queue_full", "reason": "...", ...}}
@@ -25,6 +26,22 @@ engines survive).
 Polished FASTA rides inside the JSON response base64-encoded
 (``fasta_b64``) so the framing stays single-format; the client
 decodes back to the exact bytes the polisher emitted.
+
+Telemetry ops (r12, racon_tpu/obs/export.py):
+
+* ``metrics`` — one response frame with the process registry as both
+  Prometheus text exposition (``prometheus``) and a JSON snapshot
+  with per-histogram p50/p90/p99 (``snapshot``), plus per-engine
+  device utilization (``device_util``) and the serving-SLO percentile
+  table (``slo``).
+* ``health`` — a cheap liveness/readiness document (no registry
+  walk): uptime, queue depth, draining/paused state.
+* ``watch`` — the one multi-frame op: the server streams one
+  telemetry frame (same shape as ``metrics`` minus the Prometheus
+  text) every ``interval_s`` seconds (clamped to 0.05..60, default
+  1.0), ``seq``-numbered, until the optional ``count`` is reached,
+  the client closes, or the server drains.  Every frame carries
+  ``ok: true``; the stream ending is the only termination signal.
 """
 
 from __future__ import annotations

@@ -96,6 +96,7 @@ class Job:
         self.spec = spec
         self.priority = priority
         self.estimate = estimate
+        self.t_submit: Optional[float] = None   # admission timestamp
         self.done = threading.Event()
         self.result: Optional[dict] = None   # set exactly once
 
@@ -178,10 +179,13 @@ class JobScheduler:
                     "max_queue": self.max_queue,
                     "running": len(self._running)})
             job = Job(next(self._ids), spec, priority, estimate)
+            job.t_submit = obs_trace.now()
             heapq.heappush(self._heap, (-priority, next(self._seq),
                                         job))
             REGISTRY.add("serve_jobs_submitted")
+            REGISTRY.add("serve_admit")
             REGISTRY.peak("serve_queue_high_water", len(self._heap))
+            REGISTRY.set("serve_queue_depth", len(self._heap))
             obs_trace.TRACER.add_instant(
                 "serve.submit", cat="serve",
                 args={"job": job.id, "priority": priority,
@@ -201,6 +205,15 @@ class JobScheduler:
                     return
                 _, _, job = heapq.heappop(self._heap)
                 self._running[job.id] = job
+                REGISTRY.set("serve_queue_depth", len(self._heap))
+                REGISTRY.set("serve_running", len(self._running))
+            # SLO clocks: queue wait (admission -> pop), exec wall
+            # (pop -> finish), e2e wall (admission -> finish).
+            # Observability only -- nothing downstream reads them.
+            t_pop = obs_trace.now()
+            if job.t_submit is not None:
+                REGISTRY.observe("serve_queue_wait_s",
+                                 t_pop - job.t_submit)
             try:
                 result = self._runner(job)
             except Exception as exc:   # runner bug: job fails, server
@@ -209,9 +222,23 @@ class JobScheduler:
                     "error": {"code": "job_failed",
                               "type": type(exc).__name__,
                               "reason": str(exc)}}
+            t_done = obs_trace.now()
+            exec_wall = t_done - t_pop
+            REGISTRY.observe("serve_exec_wall_s", exec_wall)
+            if job.t_submit is not None:
+                REGISTRY.observe("serve_e2e_wall_s",
+                                 t_done - job.t_submit)
+            # predicted-vs-actual drift of the admission price
+            # (calibrate.predict_walls): ratio 1.0 = perfect, the
+            # histogram's spread IS the model error
+            predicted = (job.estimate or {}).get("predicted_wall_s", 0)
+            if predicted and predicted > 0:
+                REGISTRY.observe("serve_wall_err_ratio",
+                                 exec_wall / predicted)
             with self._cond:
                 del self._running[job.id]
                 self._completed += 1
+                REGISTRY.set("serve_running", len(self._running))
                 self._cond.notify_all()
             job.finish(result)
 

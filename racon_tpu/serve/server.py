@@ -15,8 +15,16 @@
 * accepts length-prefixed JSON frames (racon_tpu/serve/protocol.py)
   on the socket — one request per connection for ``submit`` (the
   connection blocks until the job finishes; that is the client's
-  rendezvous), ``status`` / ``pause`` / ``resume`` / ``shutdown``
-  answer immediately;
+  rendezvous), ``status`` / ``pause`` / ``resume`` / ``shutdown`` /
+  ``metrics`` / ``health`` answer immediately, and ``watch`` streams
+  periodic telemetry frames on its connection until the client
+  closes or the server drains (racon-tpu top's feed);
+* optionally runs a background telemetry sampler
+  (``RACON_TPU_SERVE_SAMPLE_S`` seconds, 0 = off) that refreshes the
+  queue/uptime/device-utilization gauges in the process registry so
+  scrapes see fresh values even between requests — read-side only,
+  job bytes are pinned identical sampler-on vs off
+  (tests/test_telemetry.py);
 * drains gracefully on SIGTERM/SIGINT or a ``shutdown`` op: running
   AND queued jobs finish, new submissions get a structured
   ``draining`` reject, then the process exits 0;
@@ -62,7 +70,8 @@ class PolishServer:
         self._sock = None
         self._stop = threading.Event()
         self._handlers: list = []
-        self._last_activity = obs_trace.now()
+        self._t_start = obs_trace.now()
+        self._last_activity = self._t_start
         self._lock = threading.Lock()
 
     # -- warm state ----------------------------------------------------
@@ -105,11 +114,103 @@ class PolishServer:
             "ok": True,
             "pid": os.getpid(),
             "socket": self.socket_path,
+            "uptime_s": round(obs_trace.now() - self._t_start, 3),
+            "draining": self.scheduler.draining,
             "queue": self.scheduler.snapshot(),
             "idle_timeout_s": self.idle_timeout,
             "registry": REGISTRY.snapshot(),
             "provenance": provenance.environment(probe=False),
         }
+
+    # -- telemetry (r12) -----------------------------------------------
+
+    def telemetry_doc(self, prometheus: bool = False) -> dict:
+        """One self-contained telemetry frame: queue state, per-engine
+        device utilization, registry snapshot with percentiles, and
+        the serving-SLO table.  ``prometheus=True`` additionally
+        renders the text exposition (the ``metrics`` op; ``watch``
+        frames skip it to stay small)."""
+        from racon_tpu.obs import devutil, export
+
+        # publish BEFORE the snapshot so the exposition carries the
+        # device_util.* gauges the JSON section reports
+        du = devutil.DEVICE_UTIL.publish(REGISTRY)
+        REGISTRY.set("serve_uptime_s",
+                     round(obs_trace.now() - self._t_start, 3))
+        snap = REGISTRY.snapshot()
+        doc = {
+            "ok": True,
+            "pid": os.getpid(),
+            "uptime_s": snap["gauges"]["serve_uptime_s"],
+            "queue": self.scheduler.snapshot(),
+            "device_util": du,
+            "slo": export.slo_summary(snap),
+            "snapshot": export.json_snapshot(snap),
+        }
+        if prometheus:
+            doc["prometheus"] = export.prometheus_text(snap)
+        return doc
+
+    def _health_doc(self) -> dict:
+        """Liveness/readiness without a registry walk — cheap enough
+        for a tight poll loop."""
+        q = self.scheduler.snapshot()
+        return {
+            "ok": True,
+            "status": "draining" if q["draining"] else "ok",
+            "pid": os.getpid(),
+            "uptime_s": round(obs_trace.now() - self._t_start, 3),
+            "accepting": not q["draining"],
+            "queue_depth": q["queue_depth"],
+            "running": len(q["running"]),
+            "paused": q["paused"],
+        }
+
+    def _handle_watch(self, conn, req: dict) -> None:
+        """Stream telemetry frames on this connection (the one
+        multi-frame op).  Ends when ``count`` frames were sent, the
+        client closes, or the server drains — sleeping on
+        ``self._stop.wait`` so drain interrupts the stream
+        promptly."""
+        try:
+            interval = float(req.get("interval_s", 1.0))
+        except (TypeError, ValueError):
+            interval = 1.0
+        interval = min(max(interval, 0.05), 60.0)
+        try:
+            count = int(req.get("count", 0))
+        except (TypeError, ValueError):
+            count = 0
+        REGISTRY.add("serve_watchers")
+        sent = 0
+        try:
+            while True:
+                doc = self.telemetry_doc(prometheus=False)
+                doc["seq"] = sent
+                protocol.send_frame(conn, doc)
+                sent += 1
+                if count and sent >= count:
+                    return
+                if self._stop.wait(interval):
+                    return
+        except OSError:
+            return   # watcher went away; nothing to salvage
+
+    def _sampler_loop(self, period: float) -> None:
+        """Background gauge refresh (RACON_TPU_SERVE_SAMPLE_S): keeps
+        queue depth / uptime / device utilization current in the
+        registry between requests so an exposition scrape never reads
+        stale gauges.  Pure read-side — it writes only gauges derived
+        from state the events already maintain."""
+        from racon_tpu.obs import devutil
+
+        while not self._stop.wait(period):
+            devutil.DEVICE_UTIL.publish(REGISTRY)
+            q = self.scheduler.snapshot()
+            REGISTRY.set("serve_queue_depth", q["queue_depth"])
+            REGISTRY.set("serve_running", len(q["running"]))
+            REGISTRY.set("serve_uptime_s",
+                         round(obs_trace.now() - self._t_start, 3))
 
     def _serve_connection(self, conn) -> None:
         try:
@@ -117,10 +218,18 @@ class PolishServer:
             if req is None:
                 return
             op = req.get("op") if isinstance(req, dict) else None
+            if op == "watch":
+                # multi-frame: the handler owns the connection
+                self._handle_watch(conn, req)
+                return
             if op == "submit":
                 resp = self._handle_submit(req)
             elif op == "status":
                 resp = self._status_doc()
+            elif op == "metrics":
+                resp = self.telemetry_doc(prometheus=True)
+            elif op == "health":
+                resp = self._health_doc()
             elif op == "pause":
                 self.scheduler.pause()
                 resp = {"ok": True, "paused": True}
@@ -191,6 +300,16 @@ class PolishServer:
                f"(queue {self.scheduler.max_queue}, "
                f"jobs {self.scheduler.max_jobs}, "
                f"idle_timeout {self.idle_timeout or 'off'})")
+        try:
+            sample_s = float(
+                os.environ.get("RACON_TPU_SERVE_SAMPLE_S", "0"))
+        except ValueError:
+            sample_s = 0.0
+        if sample_s > 0:
+            threading.Thread(
+                target=self._sampler_loop,
+                args=(max(sample_s, 0.05),), daemon=True,
+                name="racon-serve-sampler").start()
         self._touch()   # prewarm time must not count against idle
         try:
             while True:

@@ -1,0 +1,129 @@
+"""``racon-tpu top``: live terminal status for a polishing daemon.
+
+Subscribes to a server's ``watch`` stream
+(racon_tpu/serve/client.py) and renders each telemetry frame as a
+compact terminal dashboard — queue state, per-engine device
+utilization, serving-SLO latency percentiles — refreshed in place
+when stderr is a TTY (ANSI home+clear), appended as plain text
+otherwise.
+
+Machine mode: ``--once --json`` prints exactly one telemetry frame
+as one JSON line and exits — the scripting/router interface (queue
+depth + predicted pressure per daemon is the fleet-routing signal
+the ROADMAP calls for).
+
+The client is read-only: every op it sends (``watch``) touches no
+queue or job state on the server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from racon_tpu.serve import client
+
+
+def _fmt_s(v) -> str:
+    v = float(v)
+    if v >= 3600:
+        return f"{v / 3600:.1f}h"
+    if v >= 60:
+        return f"{v / 60:.1f}m"
+    if v >= 1:
+        return f"{v:.1f}s"
+    return f"{v * 1000:.0f}ms"
+
+
+def render(doc: dict) -> str:
+    """One telemetry frame -> the dashboard text (pure function; the
+    tests golden it without a terminal)."""
+    q = doc.get("queue", {})
+    lines = []
+    state = ("draining" if q.get("draining")
+             else "paused" if q.get("paused") else "running")
+    lines.append(
+        f"racon-tpu serve  pid {doc.get('pid')}  "
+        f"up {_fmt_s(doc.get('uptime_s', 0))}  [{state}]")
+    lines.append(
+        f"queue  {q.get('queue_depth', 0)}/{q.get('max_queue', '?')} "
+        f"queued  {len(q.get('running', []))}/{q.get('max_jobs', '?')} "
+        f"running  {q.get('completed', 0)} done")
+
+    du = doc.get("device_util") or {}
+    if du:
+        lines.append("")
+        lines.append("engine       util  busy      idle      "
+                     "dispatches")
+        for eng in sorted(du):
+            e = du[eng]
+            lines.append(
+                f"{eng:<12s} {e['util'] * 100:4.0f}%  "
+                f"{_fmt_s(e['busy_s']):<8s}  "
+                f"{_fmt_s(e['idle_s']):<8s}  "
+                f"{e['n_dispatches']}")
+
+    slo = doc.get("slo") or {}
+    if slo:
+        lines.append("")
+        lines.append("slo                    count   p50       "
+                     "p90       p99")
+        for name in sorted(slo):
+            s = slo[name]
+            if not s.get("count"):
+                continue
+            lines.append(
+                f"{name:<22s} {s['count']:>5d}   "
+                f"{_fmt_s(s['p50']):<8s}  {_fmt_s(s['p90']):<8s}  "
+                f"{_fmt_s(s['p99']):<8s}")
+    return "\n".join(lines) + "\n"
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="racon-tpu top",
+        description="Live status view of a racon-tpu serve daemon "
+        "over its watch stream.")
+    p.add_argument("--socket", required=True,
+                   help="unix-domain socket of the server to watch")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="refresh period in seconds (default 1.0)")
+    p.add_argument("--count", type=int, default=0,
+                   help="exit after N frames (default 0 = forever)")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (implies --count 1)")
+    p.add_argument("--json", action="store_true",
+                   help="print raw telemetry frames as JSON lines "
+                   "instead of the dashboard")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    count = 1 if args.once else args.count
+    live = sys.stdout.isatty() and not args.json and count != 1
+    try:
+        for doc in client.watch(args.socket,
+                                interval_s=args.interval,
+                                count=count):
+            if args.json:
+                print(json.dumps(doc, separators=(",", ":")),
+                      flush=True)
+            else:
+                if live:
+                    # home + clear-below: redraw in place without
+                    # the full-screen alternate buffer
+                    sys.stdout.write("\x1b[H\x1b[J")
+                sys.stdout.write(render(doc))
+                sys.stdout.flush()
+    except client.ServeError as exc:
+        print(f"[racon_tpu::top] error: {exc}", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

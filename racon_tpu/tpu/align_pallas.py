@@ -58,6 +58,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from racon_tpu.obs import devutil as obs_devutil
 from racon_tpu.obs import trace as obs_trace
 
 # the sanctioned clock (racon_tpu/obs): watcher spans feed only the
@@ -721,6 +722,7 @@ def align_dispatch(queries, targets, lq: int, lt: int, wb: int,
             obs_trace.TRACER.add_span(
                 f"device.align_band{wb}", t_disp, t_end, cat="device",
                 lane="device", args={"n": n_real})
+            obs_devutil.DEVICE_UTIL.record("align_band", t_disp, t_end)
         except Exception:
             pass  # dispatch errors surface at collect()
 
@@ -1213,6 +1215,7 @@ def wfa_dispatch(queries, targets, lq: int, emax: int, mesh=None):
             obs_trace.TRACER.add_span(
                 f"device.align_wfa{emax}", t_disp, t_end,
                 cat="device", lane="device", args={"n": n_real})
+            obs_devutil.DEVICE_UTIL.record("align_wfa", t_disp, t_end)
         except Exception:
             pass  # dispatch errors surface at collect()
 

@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from racon_tpu.obs import devutil as obs_devutil
+from racon_tpu.obs import metrics as obs_metrics
 from racon_tpu.obs import trace as obs_trace
 from racon_tpu.ops import cpu as cpu_ops
 from racon_tpu.utils.tuning import poa_band_cols, scan_unroll as _unroll
@@ -485,7 +487,12 @@ class TPUPoaBatchEngine:
             finally:
                 nb.close()
 
+        # lockstep runs synchronously at dispatch time; its interval
+        # IS the engine-busy window on backends without the Pallas
+        # kernel (the watcher threads never run there)
+        t0 = _mono()
         out = run_lockstep()
+        obs_devutil.DEVICE_UTIL.record("poa", t0, _mono())
         return lambda: out
 
     # -- full on-device path (flagship Pallas kernel) ------------------
@@ -620,10 +627,16 @@ class TPUPoaBatchEngine:
             # (racon_tpu/tpu/polisher.py) shares one engine between
             # the speculative align-stage consumer thread and the
             # stage-time dispatch loop
+            dev_s = getattr(handle, "device_s", lambda: 0.0)()
             with self._reject_lock:
                 self.phase_walls["dispatch"] += blocked
-                self.device_s += getattr(handle, "device_s",
-                                         lambda: 0.0)()
+                self.device_s += dev_s
+            if dev_s > 0:
+                # per-megabatch device-time distribution (the engine
+                # only keeps the aggregate; the serve-layer latency
+                # percentiles want the shape)
+                obs_metrics.REGISTRY.observe(
+                    "poa_megabatch_device_s", dev_s)
             if os.environ.get("RACON_TPU_POA_TRACE"):
                 import sys
                 live = nlay[:n][nlay[:n] > 0]

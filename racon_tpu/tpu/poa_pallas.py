@@ -90,6 +90,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from racon_tpu.obs import devutil as obs_devutil
 from racon_tpu.obs import trace as obs_trace
 
 # the sanctioned clock (racon_tpu/obs): the watcher span feeds only
@@ -1570,6 +1571,7 @@ def poa_full_dispatch(seqs, wts, meta, nlay, bblen, *,
             obs_trace.TRACER.add_span(
                 "device.poa_megabatch", t_disp, t_end, cat="device",
                 lane="device", args={"b": int(b0)})
+            obs_devutil.DEVICE_UTIL.record("poa", t_disp, t_end)
         except Exception:
             pass  # dispatch errors surface at collect()
 

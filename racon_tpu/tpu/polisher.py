@@ -29,6 +29,7 @@ from racon_tpu.core.overlap import Overlap
 from racon_tpu.core.polisher import Polisher, PolisherType
 from racon_tpu.core.window import WindowType
 from racon_tpu.obs import MetricAttr
+from racon_tpu.obs import devutil as obs_devutil
 from racon_tpu.obs import trace as obs_trace
 
 # the one sanctioned clock (racon_tpu/obs; timestamps feed only the
@@ -1542,6 +1543,9 @@ class TPUPolisher(Polisher):
                 dev_s = getattr(coll, "device_s", lambda: 0.0)()
                 self.align_device_s += dev_s
                 self.align_wfa_device_s += dev_s
+                if dev_s > 0:
+                    self.metrics.observe("align_chunk_device_s.wfa",
+                                         dev_s)
                 steps = float(sum(min(int(d), emax) for d in dists))
                 now = _now()
                 obs_trace.TRACER.add_span(
@@ -1634,6 +1638,9 @@ class TPUPolisher(Polisher):
                 dev_s = getattr(coll, "device_s", lambda: 0.0)()
                 self.align_device_s += dev_s
                 self.align_band_device_s += dev_s
+                if dev_s > 0:
+                    self.metrics.observe("align_chunk_device_s.band",
+                                         dev_s)
                 now = _now()
                 obs_trace.TRACER.add_span(
                     f"align.chunk.band{wb}", tally["mark"], now,
@@ -1797,10 +1804,15 @@ class TPUPolisher(Polisher):
         # The probed per-run divergence replaces the hardcoded 20%
         # starting-rung guess (a 5%-divergence dataset used to pay a
         # rung it never needed)
+        # the scan ladder runs synchronously, so its interval IS the
+        # engine-busy window on backends without the Pallas kernel
+        # (where the align_pallas watcher threads never run)
+        t0 = _now()
         ops, cells, unresolved = aligner.band_align_batch(
             queries, targets, blq, blt, dispatch=dispatch,
             allow_full=False, mem_budget=self.align_mem_budget,
             need_ratio=self.align_probe_p50)
+        obs_devutil.DEVICE_UTIL.record("align_band", t0, _now())
         self.align_cells += cells
         skip = set(unresolved.tolist())
         for idx, o in enumerate(chunk):

@@ -60,8 +60,17 @@ class Logger:
             percent = self._bar_state * 5
             bar = "=" * self._bar_state + ">" + " " * (20 - self._bar_state)
             end = "\n" if self._bar_state == 20 else ""
-            print(f"\r{message} [{bar}] {percent}%", end=end,
-                  file=sys.stderr, flush=True)
+            # \r redraw only makes sense on a terminal; piped stderr
+            # (daemon logs, bench captures) gets ONE final line per
+            # bar in the same format instead of 20 \r frames
+            try:
+                tty = sys.stderr.isatty()
+            except (AttributeError, ValueError):
+                tty = False
+            if tty or self._bar_state == 20:
+                lead = "\r" if tty else ""
+                print(f"{lead}{message} [{bar}] {percent}%", end=end,
+                      file=sys.stderr, flush=True)
             if self._bar_state == 20:
                 now = time.monotonic()
                 self._time += now - self._start

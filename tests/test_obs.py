@@ -118,8 +118,12 @@ def test_registry_semantics():
     assert reg.value("missing", -1) == -1
     snap = reg.snapshot()
     assert snap["counters"]["c"] == 3
-    assert snap["histograms"]["h"] == {
-        "count": 2, "sum": 4.0, "min": 1.0, "max": 3.0}
+    h = snap["histograms"]["h"]
+    assert (h["count"], h["sum"], h["min"], h["max"]) == \
+        (2, 4.0, 1.0, 3.0)
+    # r12: observations also land in the fixed log-spaced buckets
+    # (string-keyed in snapshots), one per observation here
+    assert sum(h["buckets"].values()) == 2
     # every write propagated into the parent (process-wide totals)
     assert parent.value("c") == 3 and parent.value("hw") == 7
     json.dumps(snap)             # report-ready

@@ -1,0 +1,560 @@
+"""Live service telemetry (racon_tpu/obs/export, serve ops) — ISSUE 8.
+
+Two layers:
+
+* **pure** — bucketed-histogram quantile math, Prometheus text
+  exposition round-trip, device-utilization interval merging, the
+  bench regression gate (hermetic synthetic trajectory), the
+  non-TTY progress-bar fallback;
+* **live daemon** — a CPU-backend server with the telemetry sampler
+  ON (``RACON_TPU_SERVE_SAMPLE_S``) serving a real job: served bytes
+  must stay identical to the one-shot CLI (telemetry is read-side
+  only), and ``metrics`` / ``health`` / ``watch`` /
+  ``racon-tpu top --once --json`` / ``status --json`` must answer
+  with their documented schemas, including per-engine device
+  utilization and the serving-SLO histograms.
+"""
+
+import base64
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from racon_tpu.obs import devutil as obs_devutil    # noqa: E402
+from racon_tpu.obs import export as obs_export      # noqa: E402
+from racon_tpu.obs import metrics as obs_metrics    # noqa: E402
+from racon_tpu.serve import client                  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO_ROOT, "ci", "common", "bench_gate.py")
+
+#: one bucket spans a factor of 10^(1/4); a quantile estimate can be
+#: off by at most one bucket, so a factor-2 envelope is conservative
+BUCKET_SLACK = 2.0
+
+
+# ---------------------------------------------------------------------------
+# bucketed histograms + quantile math
+# ---------------------------------------------------------------------------
+
+def test_hist_bucket_ladder_fixed_and_monotone():
+    b = obs_metrics.HIST_BUCKETS
+    assert len(b) == 33
+    assert all(b[i] < b[i + 1] for i in range(len(b) - 1))
+    # 4 per decade over 1e-4 .. 1e4
+    assert b[0] == pytest.approx(1e-4) and b[-1] == pytest.approx(1e4)
+
+
+def test_hist_quantile_math():
+    reg = obs_metrics.Registry()
+    assert obs_metrics.hist_quantile({"count": 0}, 0.5) is None
+
+    reg.observe("one", 0.42)
+    h1 = reg.snapshot()["histograms"]["one"]
+    for q in (0.0, 0.5, 0.99, 1.0):
+        # single observation: every quantile is that value exactly
+        assert obs_metrics.hist_quantile(h1, q) == pytest.approx(0.42)
+
+    for i in range(1, 1001):
+        reg.observe("ramp", i / 1000.0)           # uniform 0.001..1.0
+    h = reg.snapshot()["histograms"]["ramp"]
+    for q, true in ((0.5, 0.5), (0.9, 0.9), (0.99, 0.99)):
+        est = obs_metrics.hist_quantile(h, q)
+        assert true / BUCKET_SLACK <= est <= true * BUCKET_SLACK, (
+            f"p{q * 100:.0f} estimate {est} too far from {true}")
+        assert h["min"] <= est <= h["max"]
+
+    # out-of-ladder values land in the overflow bucket, quantiles
+    # stay clamped to the observed range
+    reg.observe("big", 5e6)
+    reg.observe("big", 7e6)
+    hb = reg.snapshot()["histograms"]["big"]
+    assert obs_metrics.hist_quantile(hb, 0.99) <= 7e6
+
+
+def test_histogram_snapshot_isolated_from_live_registry():
+    reg = obs_metrics.Registry()
+    reg.observe("h", 1.0)
+    snap = reg.snapshot()
+    reg.observe("h", 1.0)
+    assert sum(snap["histograms"]["h"]["buckets"].values()) == 1, (
+        "snapshot shares mutable bucket state with the registry")
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def _sample_registry():
+    reg = obs_metrics.Registry()
+    reg.add("serve_admit", 7)
+    reg.add("serve_reject.queue_full", 2)
+    reg.set("serve_queue_depth", 3)
+    reg.set("device_util.poa.util", 0.75)
+    reg.set("run_note", "not-a-number")      # must be skipped
+    for i in range(100):
+        reg.observe("serve_exec_wall_s", 0.01 * (i + 1))
+    reg.observe("serve_wall_err_ratio", 1.25)
+    return reg
+
+
+def test_prometheus_text_round_trip():
+    snap = _sample_registry().snapshot()
+    text = obs_export.prometheus_text(snap)
+
+    # format basics: TYPE line per metric, prefix, histogram series
+    assert "# TYPE racon_tpu_serve_admit counter" in text
+    assert "# TYPE racon_tpu_serve_queue_depth gauge" in text
+    assert "# TYPE racon_tpu_serve_exec_wall_s histogram" in text
+    assert 'racon_tpu_serve_exec_wall_s_bucket{le="+Inf"} 100' in text
+    assert "racon_tpu_run_note" not in text
+    # dots sanitize deterministically
+    assert "racon_tpu_serve_reject_queue_full 2" in text
+    assert "racon_tpu_device_util_poa_util 0.75" in text
+
+    back = obs_export.parse_prometheus_text(text)
+    assert back["counters"]["racon_tpu_serve_admit"] == 7
+    assert back["gauges"]["racon_tpu_serve_queue_depth"] == 3
+    h = back["histograms"]["racon_tpu_serve_exec_wall_s"]
+    assert h["count"] == 100
+    assert h["sum"] == pytest.approx(
+        snap["histograms"]["serve_exec_wall_s"]["sum"])
+    # cumulative buckets are monotone and end at the count
+    cum = [h["buckets"][k] for k in h["buckets"]]
+    assert cum == sorted(cum) and cum[-1] == 100
+    assert "+Inf" in h["buckets"]
+
+    with pytest.raises(ValueError):
+        obs_export.parse_prometheus_text("sample_without_type 1\n")
+
+
+def test_json_snapshot_and_slo_summary():
+    snap = _sample_registry().snapshot()
+    js = obs_export.json_snapshot(snap)
+    pct = js["histograms"]["serve_exec_wall_s"]["percentiles"]
+    assert pct["count"] == 100
+    assert pct["min"] <= pct["p50"] <= pct["p90"] <= pct["p99"] \
+        <= pct["max"]
+
+    slo = obs_export.slo_summary(snap)
+    assert set(slo) == {"serve_exec_wall_s", "serve_wall_err_ratio"}
+    assert slo["serve_wall_err_ratio"]["p50"] == pytest.approx(
+        1.25, rel=0.01)
+
+
+# ---------------------------------------------------------------------------
+# device-utilization accounting
+# ---------------------------------------------------------------------------
+
+def test_devutil_interval_merge():
+    du = obs_devutil.DeviceUtil()
+    du.record("poa", 0.0, 1.0)
+    du.record("poa", 0.5, 2.0)     # overlap is not double-counted
+    du.record("poa", 3.0, 4.0)     # 1s idle gap
+    du.record("align_wfa", 10.0, 10.5)
+    snap = du.snapshot()
+    poa = snap["poa"]
+    assert poa["busy_s"] == pytest.approx(3.0)
+    assert poa["idle_s"] == pytest.approx(1.0)
+    assert poa["horizon_s"] == pytest.approx(4.0)
+    assert poa["util"] == pytest.approx(0.75)
+    assert poa["n_dispatches"] == 3
+    # a single dispatch is 100% utilized over its own horizon
+    assert snap["align_wfa"]["util"] == pytest.approx(1.0)
+
+    reg = obs_metrics.Registry()
+    du.publish(reg)
+    assert reg.value("device_util.poa.util") == pytest.approx(0.75)
+    assert reg.value("device_util.align_wfa.n_dispatches") == 1
+    du.reset()
+    assert du.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# scheduler SLO instrumentation (no daemon: in-process scheduler)
+# ---------------------------------------------------------------------------
+
+def test_scheduler_slo_histograms(tmp_path):
+    from racon_tpu.obs import REGISTRY
+    from racon_tpu.serve.scheduler import JobScheduler
+
+    paths = {}
+    for key in ("sequences", "overlaps", "targets"):
+        p = tmp_path / f"{key}.txt"
+        # big enough that the priced wall survives predict_walls'
+        # rounding (else the err-ratio histogram is skipped)
+        p.write_text("x" * 200_000)
+        paths[key] = str(p)
+    sched = JobScheduler(lambda job: {"ok": True}, max_queue=4,
+                         max_jobs=1)
+    try:
+        job = sched.submit(paths)
+        assert job.done.wait(timeout=30)
+    finally:
+        sched.drain(timeout=10)
+    snap = REGISTRY.snapshot()
+    for name in ("serve_queue_wait_s", "serve_exec_wall_s",
+                 "serve_e2e_wall_s", "serve_wall_err_ratio"):
+        assert snap["histograms"].get(name, {}).get("count", 0) >= 1, (
+            f"scheduler never observed {name}")
+    assert snap["counters"]["serve_admit"] >= 1
+    assert "serve_queue_depth" in snap["gauges"]
+    assert "serve_running" in snap["gauges"]
+
+
+# ---------------------------------------------------------------------------
+# bench regression gate (hermetic synthetic trajectory)
+# ---------------------------------------------------------------------------
+
+def _gate(fresh: dict, trajectory_dir: str):
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False) as f:
+        json.dump(fresh, f)
+    try:
+        return subprocess.run(
+            [sys.executable, GATE, f.name,
+             "--trajectory", trajectory_dir],
+            capture_output=True, text=True, timeout=60)
+    finally:
+        os.unlink(f.name)
+
+
+def _write_trajectory(d, values):
+    for i, v in enumerate(values, 1):
+        rec = {"parsed": {"value": v, "edit_distance": 300,
+                          "mega_device_window_share": 0.7,
+                          "deterministic": True}}
+        with open(os.path.join(d, f"BENCH_r{i:02d}.json"), "w") as f:
+            json.dump(rec, f)
+
+
+def test_bench_gate_pass_fail_and_table(tmp_path):
+    d = str(tmp_path)
+    _write_trajectory(d, [10.0, 10.5, 9.8])    # median ref = 10.0
+
+    ok = {"value": 10.4, "edit_distance": 305,
+          "mega_device_window_share": 0.68, "deterministic": True}
+    r = _gate(ok, d)
+    assert r.returncode == 0, r.stderr
+
+    # the acceptance case: an injected 20%+ wall regression fails
+    # with a readable delta table naming the metric
+    bad = dict(ok, value=10.0 * 1.25)
+    r = _gate(bad, d)
+    assert r.returncode == 1, r.stderr
+    assert "REGRESSED" in r.stderr and "value" in r.stderr
+    assert "+25.0%" in r.stderr
+
+    # quality drift and share drops gate independently of walls
+    r = _gate(dict(ok, edit_distance=400), d)
+    assert r.returncode == 1 and "edit_distance" in r.stderr
+    r = _gate(dict(ok, mega_device_window_share=0.5), d)
+    assert r.returncode == 1 and "share" in r.stderr
+
+    # nondeterminism fails outright
+    r = _gate(dict(ok, deterministic=False), d)
+    assert r.returncode == 1 and "deterministic" in r.stderr
+
+    # driver-wrapped fresh records work too
+    r = _gate({"parsed": ok, "rc": 0}, d)
+    assert r.returncode == 0, r.stderr
+
+
+def test_bench_gate_no_trajectory_is_a_pass(tmp_path):
+    r = _gate({"value": 99.0, "deterministic": True}, str(tmp_path))
+    assert r.returncode == 0, r.stderr
+
+
+def test_bench_gate_against_committed_trajectory():
+    """The real BENCH_r*.json history must accept its own newest
+    record and flag a 20% wall regression vs its own reference
+    (acceptance criterion)."""
+    import glob
+    import importlib.util
+    records = sorted(glob.glob(os.path.join(REPO_ROOT,
+                                            "BENCH_r*.json")))
+    if not records:
+        pytest.skip("no committed BENCH trajectory")
+    with open(records[-1]) as f:
+        newest = json.load(f)["parsed"]
+    r = _gate(newest, REPO_ROOT)
+    assert r.returncode == 0, r.stderr
+    # inject the regression relative to the gate's own reference so
+    # the test holds for any trajectory shape
+    spec = importlib.util.spec_from_file_location("bench_gate", GATE)
+    gate_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate_mod)
+    ref = gate_mod.reference_value(
+        gate_mod.load_trajectory(REPO_ROOT), "value")
+    assert ref and ref > 0
+    r = _gate(dict(newest, value=ref * 1.25), REPO_ROOT)
+    assert r.returncode == 1, r.stderr
+    assert "REGRESSED" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# logger: non-TTY progress bar fallback
+# ---------------------------------------------------------------------------
+
+def test_logger_bar_plain_when_stderr_not_a_tty():
+    code = (
+        "import sys; sys.path.insert(0, {root!r})\n"
+        "from racon_tpu.utils.logger import Logger\n"
+        "lg = Logger(); lg.log()\n"
+        "for _ in range(20): lg.bar('[test] stage')\n"
+    ).format(root=REPO_ROOT)
+    run = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60)
+    assert run.returncode == 0, run.stderr
+    assert "\r" not in run.stderr, (
+        "piped stderr still carries carriage-return bar redraws")
+    # exactly one final line, format unchanged
+    lines = [ln for ln in run.stderr.splitlines() if ln]
+    assert lines == ["[test] stage [====================>] 100%"]
+
+
+# ---------------------------------------------------------------------------
+# live daemon: sampler on, byte identity, telemetry ops
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_tmp():
+    # unix-socket paths must stay short (~108 bytes)
+    with tempfile.TemporaryDirectory(prefix="rttele_",
+                                     dir="/tmp") as d:
+        yield d
+
+
+@pytest.fixture(scope="module")
+def dataset(serve_tmp):
+    from racon_tpu.tools import simulate
+
+    return simulate.simulate(os.path.join(serve_tmp, "data"),
+                             genome_len=8_000, coverage=5,
+                             read_len=800, seed=21, ont=True)
+
+
+def _serve_env(serve_tmp, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "RACON_TPU_CACHE_DIR": os.path.join(serve_tmp, "cache"),
+        "RACON_TPU_CLI_PREWARM": "0",
+        "RACON_TPU_RATE_POA_DEV": "0.30",
+        "RACON_TPU_RATE_POA_CPU": "2.0",
+        "RACON_TPU_RATE_ALIGN_DEV": "1100",
+        "RACON_TPU_RATE_ALIGN_CPU": "4.0",
+        "RACON_TPU_RATE_ALIGN_WFA_DEV": "700",
+        "RACON_TPU_RATE_ALIGN_WFA_CPU": "1.0",
+    })
+    env.pop("RACON_TPU_TRACE", None)
+    env.pop("RACON_TPU_METRICS_JSON", None)
+    env.pop("RACON_TPU_SERVE_SAMPLE_S", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+@pytest.fixture(scope="module")
+def golden(dataset, serve_tmp):
+    """One-shot CLI bytes, telemetry sampler OFF — the reference the
+    sampler-ON served job must match byte-for-byte."""
+    reads, paf, draft = dataset
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "4", "-c", "1",
+         "--tpualigner-batches", "1", reads, paf, draft],
+        cwd=REPO_ROOT, capture_output=True,
+        env=_serve_env(serve_tmp), timeout=600)
+    assert run.returncode == 0, run.stderr.decode()
+    assert run.stdout.startswith(b">")
+    return run.stdout
+
+
+def _spec(dataset):
+    reads, paf, draft = dataset
+    return {"sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 4, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1}
+
+
+@pytest.fixture(scope="module")
+def telemetry_server(serve_tmp):
+    """One daemon with the background telemetry sampler ON."""
+    sock_path = os.path.join(serve_tmp, "tele.sock")
+    log = open(os.path.join(serve_tmp, "tele.log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve",
+         "--socket", sock_path],
+        cwd=REPO_ROOT, stdout=log, stderr=log,
+        env=_serve_env(serve_tmp,
+                       {"RACON_TPU_SERVE_SAMPLE_S": "0.2"}))
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            log.close()
+            raise AssertionError(
+                "server died at startup: " + open(log.name).read())
+        if os.path.exists(sock_path):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock_path)
+            except OSError:
+                pass
+            else:
+                log.close()
+                break
+            finally:
+                probe.close()
+        time.sleep(0.2)
+    else:
+        proc.kill()
+        log.close()
+        raise AssertionError("server socket never came up")
+    yield proc, sock_path
+    if proc.poll() is None:
+        try:
+            client.admin(sock_path, "shutdown")
+        except client.ServeError:
+            proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_sampler_on_job_byte_identical(telemetry_server, dataset,
+                                       golden):
+    """THE determinism pin: with the telemetry sampler running, a
+    served job's bytes equal the sampler-off one-shot CLI's."""
+    _, sock_path = telemetry_server
+    resp = client.submit(sock_path, _spec(dataset))
+    assert resp["ok"], resp
+    assert base64.b64decode(resp["fasta_b64"]) == golden, (
+        "telemetry sampler changed the served job's bytes")
+    # device utilization is exported in the job report too
+    du = resp["report"].get("device_util", {})
+    assert "poa" in du, du
+    assert any(e.startswith("align") for e in du), du
+    for e in du.values():
+        assert 0.0 <= e["util"] <= 1.0
+        assert e["n_dispatches"] >= 1
+
+
+def test_metrics_op_live_exposition(telemetry_server):
+    _, sock_path = telemetry_server
+    doc = client.metrics(sock_path)
+    assert doc["ok"] and doc["uptime_s"] > 0
+    assert doc["queue"]["queue_depth"] == 0
+
+    # the exposition parses and carries the serving SLO histograms
+    # (a job ran in the previous test) with bucketed series
+    back = obs_export.parse_prometheus_text(doc["prometheus"])
+    for name in ("racon_tpu_serve_exec_wall_s",
+                 "racon_tpu_serve_e2e_wall_s",
+                 "racon_tpu_serve_queue_wait_s",
+                 "racon_tpu_serve_wall_err_ratio"):
+        h = back["histograms"].get(name)
+        assert h and h["count"] >= 1, f"missing histogram {name}"
+        assert len(h["buckets"]) >= 1
+    assert back["counters"]["racon_tpu_serve_admit"] >= 1
+    assert "racon_tpu_serve_queue_depth" in back["gauges"]
+    # device-util gauges made it into the exposition
+    assert any(k.startswith("racon_tpu_device_util_")
+               for k in back["gauges"]), sorted(back["gauges"])[:20]
+
+    # JSON twin: percentiles attached, SLO table populated
+    pct = doc["snapshot"]["histograms"]["serve_exec_wall_s"][
+        "percentiles"]
+    assert pct["p50"] <= pct["p99"]
+    assert "serve_exec_wall_s" in doc["slo"]
+    assert "poa" in doc["device_util"]
+
+
+def test_health_op(telemetry_server):
+    _, sock_path = telemetry_server
+    doc = client.health(sock_path)
+    assert doc["ok"] and doc["status"] == "ok"
+    assert doc["accepting"] is True
+    assert doc["uptime_s"] > 0
+    assert doc["queue_depth"] == 0 and doc["running"] == 0
+    assert doc["paused"] is False
+
+
+def test_watch_op_streams_frames(telemetry_server):
+    _, sock_path = telemetry_server
+    frames = list(client.watch(sock_path, interval_s=0.1, count=3,
+                               timeout=30))
+    assert len(frames) == 3
+    assert [f["seq"] for f in frames] == [0, 1, 2]
+    for f in frames:
+        assert f["ok"]
+        assert "queue" in f and "device_util" in f and "slo" in f
+        assert "snapshot" in f
+        assert "prometheus" not in f   # watch frames stay small
+    assert frames[-1]["uptime_s"] >= frames[0]["uptime_s"]
+
+
+def test_top_once_json_machine_mode(telemetry_server):
+    """Acceptance: top --once --json returns queue depth and
+    per-engine device utilization on one JSON line."""
+    _, sock_path = telemetry_server
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "top",
+         "--socket", sock_path, "--once", "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert run.returncode == 0, run.stderr
+    lines = [ln for ln in run.stdout.splitlines() if ln]
+    assert len(lines) == 1
+    doc = json.loads(lines[0])
+    assert doc["ok"] and "queue_depth" in doc["queue"]
+    assert "poa" in doc["device_util"]
+    assert any(e.startswith("align") for e in doc["device_util"])
+
+    # the human renderer digests the same frame (pure function)
+    from racon_tpu.serve import top
+    text = top.render(doc)
+    assert "queue" in text and "engine" in text and "poa" in text
+
+
+def test_top_dashboard_mode(telemetry_server):
+    _, sock_path = telemetry_server
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "top",
+         "--socket", sock_path, "--count", "2", "--interval", "0.1"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert run.returncode == 0, run.stderr
+    assert run.stdout.count("racon-tpu serve  pid") == 2
+    assert "\x1b[" not in run.stdout   # no ANSI when piped
+
+
+def test_status_json_flag(telemetry_server):
+    _, sock_path = telemetry_server
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "status",
+         "--socket", sock_path, "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert run.returncode == 0, run.stderr
+    doc = json.loads(run.stdout)
+    assert doc["ok"] and doc["uptime_s"] > 0
+    assert doc["draining"] is False
+    assert "queue_depth" in doc["queue"]
+
+    # human mode: a compact summary, not a JSON dump
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "status",
+         "--socket", sock_path],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert run.returncode == 0, run.stderr
+    assert "queue" in run.stdout and "state" in run.stdout
+    with pytest.raises(ValueError):
+        json.loads(run.stdout)
