@@ -15,6 +15,9 @@ table — when a watched metric regressed past its threshold:
   jitter by a handful of edits between hosts).
 * **share metrics** (higher is better, 0..1): fail when the device
   window share drops more than 0.10 absolute.
+* **rate metrics** (throughput, higher is better): fail when the
+  fresh rate drops more than ``--wall-tol`` relative (serving
+  throughput jitters with the same host factors walls do).
 * ``deterministic: false`` in the fresh record fails outright.
 
 The reference value for each metric is the **median of the newest
@@ -64,6 +67,14 @@ DIST_METRICS = (
 SHARE_METRICS = (
     "mega_device_window_share",
     "mega_ont_device_window_share",
+    "serve_sat_poa_util",
+    "serve_sat_fusion_occupancy",
+)
+
+#: throughput metrics, higher is better (relative threshold, shares
+#: the wall tolerance -- both measure the same host jitter)
+RATE_METRICS = (
+    "serve_sat_jobs_per_s",
 )
 
 #: absolute slack for edit-distance drift on top of the relative tol
@@ -123,9 +134,25 @@ def check(fresh: dict, trajectory: list, wall_tol: float,
         ref = reference_value(trajectory, key)
         if not isinstance(new, (int, float)) or ref is None or ref <= 0:
             continue
+        # a carried-forward wall (budget-skipped leg, r13) is an old
+        # measurement re-shipped with provenance -- gating it would
+        # compare the reference against itself
+        prov_key = (key[:-2] if key.endswith("_s") else key) \
+            + "_provenance"
+        if fresh.get(prov_key):
+            continue
         ratio = float(new) / ref
         row(key, "wall", ref, float(new), ratio > 1.0 + wall_tol,
             f"{(ratio - 1.0) * 100:+.1f}% vs tol +{wall_tol * 100:.0f}%")
+
+    for key in RATE_METRICS:
+        new = fresh.get(key)
+        ref = reference_value(trajectory, key)
+        if not isinstance(new, (int, float)) or ref is None or ref <= 0:
+            continue
+        ratio = float(new) / ref
+        row(key, "rate", ref, float(new), ratio < 1.0 - wall_tol,
+            f"{(ratio - 1.0) * 100:+.1f}% vs tol -{wall_tol * 100:.0f}%")
 
     for key in DIST_METRICS:
         new = fresh.get(key)

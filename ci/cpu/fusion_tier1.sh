@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Cross-job fusion tier-1 (ISSUE r13 CI satellite): the fused device
+# executor must be a pure batching optimization — same bytes per job,
+# fused or not, concurrent or standalone.
+#
+#   1. tier-1 with fusion pinned ON (RACON_TPU_FUSE=1 is the default;
+#      the pin keeps this lane meaningful if the default ever changes)
+#      AND RACON_TPU_FUSE_FORCE=1, which routes even single-tenant
+#      work through the fused dispatcher thread — so the ENTIRE suite,
+#      including every standalone byte-identity golden, runs on the
+#      fused code path.  PYTHONDEVMODE=1 surfaces unjoined dispatcher
+#      threads and leaked executor pools; the faulthandler timeout
+#      dumps every thread's stack if batch formation ever deadlocks
+#      (the failure mode that matters for a fuse-wait + quota loop).
+#   2. 3-job concurrent serve byte-identity smoke: three jobs with
+#      distinct tenants polished concurrently through the scheduler
+#      with fusion on, each compared byte for byte against the
+#      one-shot CLI run of the same inputs.  The in-suite twins
+#      (tests/test_executor.py, tests/test_serve.py) pin the same
+#      contract; this leg re-checks it standalone so a suite-ordering
+#      accident can't mask a fusion byte break.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export RACON_TPU_FUSE=1
+export RACON_TPU_FUSE_FORCE=1
+export PYTHONDEVMODE=1
+python -m pytest tests/ -q -m "not slow" \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"
+
+echo "[fusion_tier1] 3-job concurrent fused serve vs one-shot CLI"
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+JAX_PLATFORMS=cpu python - "$work" <<'EOF'
+import base64
+import subprocess
+import sys
+
+from racon_tpu.tools import simulate
+
+work = sys.argv[1]
+reads, paf, draft = simulate.simulate(work, genome_len=12_000,
+                                      coverage=5, read_len=900,
+                                      seed=7, ont=True)
+ref = subprocess.run(
+    [sys.executable, "-m", "racon_tpu.cli", "-t", "4", "-c", "1",
+     "--tpualigner-batches", "1", reads, paf, draft],
+    capture_output=True, timeout=600)
+assert ref.returncode == 0, ref.stderr.decode()
+assert ref.stdout.startswith(b">")
+
+from racon_tpu.serve.scheduler import JobScheduler
+from racon_tpu.serve.session import run_job
+
+sched = JobScheduler(run_job, max_queue=3, max_jobs=3)
+try:
+    jobs = [sched.submit({
+        "sequences": reads, "overlaps": paf, "targets": draft,
+        "threads": 4, "tpu_poa_batches": 1,
+        "tpu_aligner_batches": 1, "tenant": f"smoke{i}"})
+        for i in range(3)]
+    for j in jobs:
+        assert j.done.wait(600), "fused job timed out"
+finally:
+    sched.drain(timeout=60)
+for j in jobs:
+    assert j.result.get("ok"), j.result
+    assert base64.b64decode(j.result["fasta_b64"]) == ref.stdout, \
+        "fused serve bytes != one-shot CLI bytes"
+print("fused 3-job bytes == one-shot CLI bytes")
+EOF
+echo "FUSION CI PASS"

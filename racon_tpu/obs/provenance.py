@@ -64,6 +64,12 @@ KNOWN_KNOBS = {
     "RACON_TPU_SERVE_ALIGN_MBPS": "",
     "RACON_TPU_SERVE_POA_MBPS": "",
     "RACON_TPU_CALIB_FREEZE": "",
+    # cross-job fused device executor (r13, racon_tpu/tpu/executor):
+    # fusion off-switch, fusion window, per-tenant in-flight quota
+    "RACON_TPU_FUSE": "1",
+    "RACON_TPU_FUSE_FORCE": "0",
+    "RACON_TPU_FUSE_WAIT_MS": "5",
+    "RACON_TPU_SERVE_TENANT_QUOTA": "2",
     # serving telemetry (r12): background sampler period for the
     # queue/device-util gauges (0 = off; read side only, never
     # control flow), bench regression gate opt-in

@@ -117,10 +117,13 @@ def watch(socket_path: str, interval_s: float = 1.0, count: int = 0,
         sock.close()
 
 
-def spec_from_opts(opts: dict, inputs) -> dict:
+def spec_from_opts(opts: dict, inputs, tenant: str = None) -> dict:
     """One-shot CLI options -> job spec (racon_tpu/serve/session.py
-    resolves omitted keys to the same CLI defaults)."""
-    return {
+    resolves omitted keys to the same CLI defaults).  ``tenant`` tags
+    the job for the fused device executor's per-tenant fairness and
+    SLO accounting; it never affects output bytes."""
+    spec = {} if tenant is None else {"tenant": tenant}
+    spec.update({
         "sequences": os.path.abspath(inputs[0]),
         "overlaps": os.path.abspath(inputs[1]),
         "targets": os.path.abspath(inputs[2]),
@@ -137,13 +140,14 @@ def spec_from_opts(opts: dict, inputs) -> dict:
         "tpu_poa_batches": opts["tpu_poa_batches"],
         "tpu_banded_alignment": opts["tpu_banded_alignment"],
         "tpu_aligner_batches": opts["tpu_aligner_batches"],
-    }
+    })
+    return spec
 
 
 def _split_serve_flags(argv):
-    """Pull --socket/--priority out of the argv so the rest parses
-    with the unchanged one-shot ``cli.parse_args``."""
-    socket_path, priority = None, 0
+    """Pull --socket/--priority/--tenant out of the argv so the rest
+    parses with the unchanged one-shot ``cli.parse_args``."""
+    socket_path, priority, tenant = None, 0, None
     rest = []
     i = 0
     while i < len(argv):
@@ -158,16 +162,21 @@ def _split_serve_flags(argv):
             priority = int(argv[i]) if i < len(argv) else 0
         elif a.startswith("--priority="):
             priority = int(a.split("=", 1)[1])
+        elif a == "--tenant":
+            i += 1
+            tenant = argv[i] if i < len(argv) else None
+        elif a.startswith("--tenant="):
+            tenant = a.split("=", 1)[1]
         else:
             rest.append(a)
         i += 1
-    return socket_path, priority, rest
+    return socket_path, priority, tenant, rest
 
 
 def main_submit(argv) -> int:
     from racon_tpu import cli
 
-    socket_path, priority, rest = _split_serve_flags(argv)
+    socket_path, priority, tenant, rest = _split_serve_flags(argv)
     if not socket_path:
         print("[racon_tpu::submit] error: --socket PATH is required!",
               file=sys.stderr)
@@ -178,7 +187,8 @@ def main_submit(argv) -> int:
               file=sys.stderr)
         return 1
     try:
-        resp = submit(socket_path, spec_from_opts(opts, inputs),
+        resp = submit(socket_path,
+                      spec_from_opts(opts, inputs, tenant=tenant),
                       priority=priority)
     except ServeError as exc:
         print(f"[racon_tpu::submit] error: {exc}", file=sys.stderr)
@@ -210,7 +220,7 @@ def main_submit(argv) -> int:
 
 
 def main_status(argv) -> int:
-    socket_path, _, rest = _split_serve_flags(argv)
+    socket_path, _, _, rest = _split_serve_flags(argv)
     as_json = "--json" in rest
     rest = [a for a in rest if a != "--json"]
     if not socket_path or rest:

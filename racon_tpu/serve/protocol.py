@@ -27,6 +27,15 @@ Polished FASTA rides inside the JSON response base64-encoded
 (``fasta_b64``) so the framing stays single-format; the client
 decodes back to the exact bytes the polisher emitted.
 
+A submission's job spec may carry an optional ``tenant`` tag (string,
+<= 64 chars, default ``"default"``; client flag ``--tenant``): the
+tenant the job's device work is accounted to in the r13 cross-job
+fused executor (racon_tpu/tpu/executor.py) — fusion stats surface
+under ``fusion`` in the ``metrics``/``watch`` telemetry, per-tenant
+queue-wait SLOs as ``serve_queue_wait_s.<tenant>`` /
+``serve_tenant_wait_s.<tenant>`` histograms.  The tag never affects
+output bytes, only fairness/accounting.
+
 Telemetry ops (r12, racon_tpu/obs/export.py):
 
 * ``metrics`` — one response frame with the process registry as both

@@ -19,12 +19,17 @@ the process's warm engines.
   e.g. a fleet scheduler — decides whether to retry, reroute or shed.
 * **Multi-job scheduling** — ``RACON_TPU_SERVE_JOBS`` worker threads
   (default 2) pop jobs in (priority desc, FIFO) order and run them
-  concurrently; their megabatch dispatches interleave through the
-  shared device FIFO (JAX serializes the actual device queue), so a
-  small job is not stuck behind a large one's CPU-side tail.  Output
-  bytes stay per-job deterministic: each job owns its polisher, and
-  engine assignment inside a polisher is a pure function of that
-  job's input (see racon_tpu/serve/__init__.py).
+  concurrently; each job runs as a *tenant* of the process-wide
+  device executor (racon_tpu/tpu/executor.py), so concurrent jobs'
+  compatible megabatches FUSE into shared full batches instead of
+  merely interleaving half-empty ones, with weighted deficit-round-
+  robin fairness and a per-tenant in-flight quota
+  (``RACON_TPU_SERVE_TENANT_QUOTA``) keeping a streaming mega-job
+  from starving small tenants.  Output bytes stay per-job
+  deterministic: each job owns its polisher, engine assignment
+  inside a polisher is a pure function of that job's input, and the
+  executor demuxes fused results by submission slice (see
+  racon_tpu/serve/__init__.py).
 * **Lifecycle** — ``pause()``/``resume()`` gate the workers without
   touching running jobs (maintenance windows; also what makes the
   backpressure/drain tests timing-independent); ``drain()`` stops
@@ -61,12 +66,24 @@ _ALIGN_MB_PER_S = 4.0
 _POA_MB_PER_S = 2.0
 
 
-def estimate_job(spec: dict) -> dict:
+def _mean_fusion_occupancy() -> float:
+    """Mean of the executor's ``fusion_occupancy`` histogram (0.0
+    before any fused dispatch) — the measured input to the r13
+    shared-pricing model."""
+    h = REGISTRY.snapshot()["histograms"].get("fusion_occupancy")
+    if not h or not h.get("count"):
+        return 0.0
+    return h["sum"] / h["count"]
+
+
+def estimate_job(spec: dict, concurrency: int = 1) -> dict:
     """Price a submission from input stats alone.
 
     Returns the :func:`calibrate.predict_walls` dict (additive wall,
-    overlapped floor, predicted wall) plus the raw inputs that
-    produced it, so a reject is auditable from the response."""
+    overlapped floor, predicted wall — plus ``shared_wall_s`` when
+    the job would share the device with ``concurrency - 1`` others)
+    plus the raw inputs that produced it, so a reject is auditable
+    from the response."""
     from racon_tpu.utils import calibrate
 
     sizes = {}
@@ -82,7 +99,9 @@ def estimate_job(spec: dict) -> dict:
     align_s = (sizes["sequences"] + sizes["overlaps"]) / mb / align_mbps
     poa_s = (sizes["sequences"] + sizes["targets"]) / mb / poa_mbps
     est = calibrate.predict_walls(align_s, poa_s,
-                                  overlap_s=min(align_s, poa_s))
+                                  overlap_s=min(align_s, poa_s),
+                                  concurrency=concurrency,
+                                  occupancy=_mean_fusion_occupancy())
     est["input_bytes"] = sizes
     return est
 
@@ -91,11 +110,12 @@ class Job:
     """One queued submission: spec + completion rendezvous."""
 
     def __init__(self, job_id: int, spec: dict, priority: int,
-                 estimate: dict):
+                 estimate: dict, tenant: str = "default"):
         self.id = job_id
         self.spec = spec
         self.priority = priority
         self.estimate = estimate
+        self.tenant = tenant
         self.t_submit: Optional[float] = None   # admission timestamp
         self.done = threading.Event()
         self.result: Optional[dict] = None   # set exactly once
@@ -153,14 +173,28 @@ class JobScheduler:
                     "code": "input_not_found",
                     "reason": f"{key} file not found on the server "
                               f"host: {path}"})
-        estimate = estimate_job(spec)
+        tenant = spec.get("tenant", "default")
+        if not isinstance(tenant, str) or not tenant \
+                or len(tenant) > 64:
+            raise RejectError({
+                "code": "bad_request",
+                "reason": "tenant must be a non-empty string "
+                          "of at most 64 characters"})
+        # price against the load the job would actually share the
+        # device with (approximate read outside the lock is fine --
+        # admission only needs the right order of magnitude)
+        with self._cond:
+            concurrency = len(self._running) + len(self._heap) + 1
+        estimate = estimate_job(spec, concurrency=concurrency)
         cap = os.environ.get("RACON_TPU_SERVE_MAX_WALL_S")
-        if cap and estimate["predicted_wall_s"] > float(cap):
+        priced = estimate.get("shared_wall_s",
+                              estimate["predicted_wall_s"])
+        if cap and priced > float(cap):
             REGISTRY.add("serve_reject.job_too_large")
             raise RejectError({
                 "code": "job_too_large",
-                "reason": f"predicted wall "
-                          f"{estimate['predicted_wall_s']:.1f}s exceeds "
+                "reason": f"predicted wall {priced:.1f}s "
+                          f"(at concurrency {concurrency}) exceeds "
                           f"RACON_TPU_SERVE_MAX_WALL_S={cap}",
                 "estimate": estimate})
         with self._cond:
@@ -178,7 +212,8 @@ class JobScheduler:
                     "queue_depth": len(self._heap),
                     "max_queue": self.max_queue,
                     "running": len(self._running)})
-            job = Job(next(self._ids), spec, priority, estimate)
+            job = Job(next(self._ids), spec, priority, estimate,
+                      tenant=tenant)
             job.t_submit = obs_trace.now()
             heapq.heappush(self._heap, (-priority, next(self._seq),
                                         job))
@@ -198,9 +233,14 @@ class JobScheduler:
     def _worker_loop(self) -> None:
         while True:
             with self._cond:
+                # event-driven wakeup: every transition that could
+                # unblock a worker (submit/resume/start_drain/stop)
+                # notifies, so no timeout-poll -- a submission admits
+                # the instant a worker is free instead of up to 500 ms
+                # later, and an idle daemon stops waking 2x/s
                 while not self._stopped and (
                         self._paused or not self._heap):
-                    self._cond.wait(0.5)
+                    self._cond.wait()
                 if self._stopped:
                     return
                 _, _, job = heapq.heappop(self._heap)
@@ -214,6 +254,17 @@ class JobScheduler:
             if job.t_submit is not None:
                 REGISTRY.observe("serve_queue_wait_s",
                                  t_pop - job.t_submit)
+                REGISTRY.observe(
+                    f"serve_queue_wait_s.{job.tenant}",
+                    t_pop - job.t_submit)
+            # the job is a device-executor tenant for its lifetime:
+            # its megabatches fuse with other registered tenants',
+            # under the executor's DRR fairness + in-flight quota
+            from racon_tpu.tpu import executor as device_executor
+
+            ex = device_executor.get_executor()
+            ex.register_tenant(job.tenant,
+                               weight=max(1.0, 1.0 + job.priority))
             try:
                 result = self._runner(job)
             except Exception as exc:   # runner bug: job fails, server
@@ -222,6 +273,8 @@ class JobScheduler:
                     "error": {"code": "job_failed",
                               "type": type(exc).__name__,
                               "reason": str(exc)}}
+            finally:
+                ex.release_tenant(job.tenant)
             t_done = obs_trace.now()
             exec_wall = t_done - t_pop
             REGISTRY.observe("serve_exec_wall_s", exec_wall)

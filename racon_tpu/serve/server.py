@@ -134,6 +134,8 @@ class PolishServer:
 
         # publish BEFORE the snapshot so the exposition carries the
         # device_util.* gauges the JSON section reports
+        from racon_tpu.tpu import executor as device_executor
+
         du = devutil.DEVICE_UTIL.publish(REGISTRY)
         REGISTRY.set("serve_uptime_s",
                      round(obs_trace.now() - self._t_start, 3))
@@ -144,6 +146,7 @@ class PolishServer:
             "uptime_s": snap["gauges"]["serve_uptime_s"],
             "queue": self.scheduler.snapshot(),
             "device_util": du,
+            "fusion": device_executor.get_executor().stats(),
             "slo": export.slo_summary(snap),
             "snapshot": export.json_snapshot(snap),
         }

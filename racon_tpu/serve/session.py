@@ -82,6 +82,11 @@ def run_job(job) -> dict:
                 opts["gap"], opts["threads"],
                 opts["tpu_poa_batches"], opts["tpu_banded_alignment"],
                 opts["tpu_aligner_batches"])
+            # tag the polisher's device submissions with the job's
+            # tenant so the process-wide executor can fuse them with
+            # other tenants' batches and enforce per-tenant fairness
+            polisher._executor_tenant = getattr(job, "tenant",
+                                                "default")
             polisher.initialize()
             polished = polisher.polish(opts["drop_unpolished"])
         fasta = b"".join(b">" + s.name.encode() + b"\n" + s.data

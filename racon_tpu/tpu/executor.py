@@ -1,0 +1,704 @@
+"""Job-agnostic device executor: cross-job continuous batching.
+
+The serve daemon (racon_tpu/serve) runs up to ``RACON_TPU_SERVE_JOBS``
+polishing jobs concurrently, but before this module each job's
+polisher owned its own slice of the device FIFO: every megabatch --
+POA windows through ``TPUPoaBatchEngine.consensus_batch_async``,
+align pairs through ``align_pallas.wfa_dispatch``/``align_dispatch``
+-- was built from ONE job's ready work.  At the many-small-jobs
+operating point the device therefore runs half-empty batches while
+other jobs' ready windows wait in their own queues (the reference
+racon-gpu wins precisely by filling its fixed cudapoa batch caps).
+
+This module inverts that ownership.  ``DeviceExecutor`` is a
+process-wide service that accepts *tagged work units* from any number
+of concurrent jobs (tenants), fuses compatible units into shared
+megabatches, dispatches them through the unchanged engine/Pallas
+paths, and demuxes results back to each submitter by position.
+
+Byte contract
+-------------
+Fusion must never change any job's output bytes.  That holds because
+every fused path is *per-item independent*:
+
+* POA: a window's consensus depends only on that window's sequences
+  (graph build, bucketed kernel run, and traceback are all per-window;
+  batch maxima only change padding, which is masked).  The engine is
+  result-stateless -- config + inputs only -- so SHARING one engine
+  across jobs is safe, and a fused batch returns, for each unit, the
+  exact sequence of per-window results the unit's own dispatch would
+  have produced, in the unit's own order.
+* Align: ``wfa_dispatch``/``align_dispatch`` batch independent
+  per-pair lanes (padding via ``pad_pairs``); concatenating two
+  units' pairs and slicing the stacked result rows is identical to
+  two separate dispatches.
+
+What is NOT fused: the CPU scan path (``band_align_batch`` under
+``_align_chunk``) -- its internal chunking/memory heuristics depend
+on batch composition, so it stays per-job.
+
+Compatibility buckets
+---------------------
+Units only fuse when a shared dispatch is exactly equivalent to the
+separate ones: POA units must share the engine (full scoring/cap
+config, same device mesh) and ``trim``; align units must share the
+rung geometry (bucket dims, error cap / band width) and mesh.  Mixed
+window types inside one fused POA batch are fine -- the engine
+already splits per type internally.
+
+Fusion window and fairness
+--------------------------
+A dispatcher thread holds the head unit of a bucket for up to
+``RACON_TPU_FUSE_WAIT_MS`` (default 5 ms) waiting for batchmates, or
+less if the bucket reaches its occupancy target (the largest
+participating unit's device batch cap -- fusing never exceeds the
+memory envelope any single participant already sized for).  Batch
+formation is weighted deficit-round-robin over tenants with pending
+units, and a per-tenant in-flight quota
+(``RACON_TPU_SERVE_TENANT_QUOTA``, default 2 outstanding device
+submissions) keeps one streaming mega-job from starving small
+tenants: an at-quota tenant's units are held back while any other
+tenant has pending work (the quota is work-conserving -- alone, a
+tenant runs unthrottled).
+
+Single-tenant degradation
+-------------------------
+With fusion disabled (``RACON_TPU_FUSE=0``) or fewer than two
+registered tenants (the standalone CLI registers none), submissions
+take a synchronous passthrough: the direct engine / align_pallas call
+on the calling thread with the caller's pool -- bit-for-bit and
+thread-for-thread identical to the pre-executor code.
+``RACON_TPU_FUSE_FORCE=1`` routes even single-tenant work through the
+dispatcher (same bytes, different threading) so the fused code path
+can be pinned under the full tier-1 suite (ci/cpu/fusion_tier1.sh).
+
+Crash containment
+-----------------
+A failure while dispatching or collecting a fused batch falls back to
+retrying each unit individually; a unit whose own retry fails raises
+in that unit's ``collect()`` only, so one job's poisoned window can
+never fail its batchmates.
+
+Observability: ``fused_megabatches`` / ``fusion_units_fused``
+counters, a ``fusion_occupancy`` histogram (fused size / occupancy
+target), and per-tenant queue-wait SLO histograms
+(``serve_tenant_wait_s.<tenant>``) in the process registry; the
+serve daemon surfaces ``DeviceExecutor.stats()`` under ``fusion`` in
+its ``metrics``/``top`` telemetry.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+
+from racon_tpu.obs import REGISTRY
+
+_mono = time.monotonic
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def fuse_enabled() -> bool:
+    return os.environ.get("RACON_TPU_FUSE", "1") != "0"
+
+
+def fuse_forced() -> bool:
+    return os.environ.get("RACON_TPU_FUSE_FORCE", "0") == "1"
+
+
+def fuse_wait_s() -> float:
+    return max(0.0, _env_float("RACON_TPU_FUSE_WAIT_MS", 5.0)) / 1e3
+
+
+def tenant_quota() -> int:
+    """Max outstanding device submissions per tenant while other
+    tenants have pending work; <= 0 disables the quota."""
+    return _env_int("RACON_TPU_SERVE_TENANT_QUOTA", 2)
+
+
+def _mesh_key(mesh):
+    if mesh is None:
+        return None
+    try:
+        return tuple(str(d) for d in mesh.devices.flat)
+    except AttributeError:
+        return tuple(str(d) for d in getattr(mesh, "devices", ()))
+
+
+# ---------------------------------------------------------------------------
+# work units
+# ---------------------------------------------------------------------------
+
+class _Unit:
+    """One tenant's submission: a POA window batch or an align pair
+    batch, fused whole (never split) into a shared dispatch."""
+
+    __slots__ = ("kind", "tenant", "payload", "size", "cap", "pool",
+                 "t_submit", "done", "fused", "lo", "hi", "retry",
+                 "fuse_dispatch")
+
+    def __init__(self, kind, tenant, payload, size, cap, pool):
+        self.kind = kind            # "poa" | "wfa" | "band"
+        self.tenant = tenant or "default"
+        self.payload = payload
+        self.size = size
+        self.cap = cap              # submitter's own device batch cap
+        self.pool = pool
+        self.t_submit = _mono()
+        self.done = threading.Event()
+        self.fused = None           # _FusedDispatch once dispatched
+        self.lo = self.hi = 0       # slice of the fused batch
+        self.retry = None           # per-unit fallback dispatch fn
+
+
+class _FusedDispatch:
+    """One shared device dispatch covering >= 1 units.  The collect
+    is memoized under a lock: the first unit to collect runs it, the
+    rest read the cached rows.  A failure poisons only the shared
+    attempt -- each unit then retries individually (crash
+    containment)."""
+
+    def __init__(self, collect, n_items, units):
+        self._collect = collect
+        self._lock = threading.Lock()
+        self._result = None
+        self._error = None
+        self._ran = False
+        self.n_items = n_items
+        self.units = units
+
+    def result(self):
+        with self._lock:
+            if not self._ran:
+                try:
+                    self._result = self._collect()
+                except BaseException as exc:  # containment boundary
+                    self._error = exc
+                self._ran = True
+            if self._error is not None:
+                raise _FusedBatchError(self._error)
+            return self._result
+
+    def device_s(self) -> float:
+        ds = getattr(self._collect, "device_s", None)
+        try:
+            return float(ds()) if callable(ds) else 0.0
+        except Exception:
+            return 0.0
+
+
+class _FusedBatchError(Exception):
+    """Shared dispatch failed; units fall back to individual retries."""
+
+    def __init__(self, cause):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+# ---------------------------------------------------------------------------
+# POA engine handle
+# ---------------------------------------------------------------------------
+
+class PoaEngineHandle:
+    """Per-polisher view of a shared ``TPUPoaBatchEngine``.
+
+    Mimics the slice of the engine API the polisher consumes
+    (``will_dispatch_async``, ``consensus_batch_async`` and the
+    observability counters) while the engine itself is shared across
+    jobs.  Counters are reported as deltas from a creation-time
+    snapshot; under concurrent sharing a delta can attribute another
+    job's dispatch to this handle -- the same documented one-registry
+    ambiguity serve/session.py accepts for the process-wide shelf
+    counters.  The numbers feed logs, metrics and calibration (and
+    calibration is frozen in serve), never output bytes.
+    """
+
+    def __init__(self, executor, engine, tenant, cap):
+        self._ex = executor
+        self._eng = engine
+        self.tenant = tenant
+        self.cap = max(0, int(cap))
+        self._base = {
+            "device_s": engine.device_s,
+            "cells": engine.cells,
+            "n_rounds": engine.n_rounds,
+            "n_skipped_layers": engine.n_skipped_layers,
+            "reject": dict(engine.reject_counts),
+            "phase": dict(engine.phase_walls),
+        }
+
+    # -- engine API the polisher drives ------------------------------------
+    def will_dispatch_async(self, windows) -> bool:
+        return self._eng.will_dispatch_async(windows)
+
+    def consensus_batch_async(self, windows, trim, pool=None):
+        return self._ex.submit_poa(self, windows, trim, pool)
+
+    # -- observability deltas ----------------------------------------------
+    @property
+    def device_s(self):
+        return self._eng.device_s - self._base["device_s"]
+
+    @property
+    def cells(self):
+        return self._eng.cells - self._base["cells"]
+
+    @property
+    def n_rounds(self):
+        return self._eng.n_rounds - self._base["n_rounds"]
+
+    @property
+    def n_skipped_layers(self):
+        return (self._eng.n_skipped_layers
+                - self._base["n_skipped_layers"])
+
+    @property
+    def reject_counts(self):
+        base = self._base["reject"]
+        return {k: v - base.get(k, 0)
+                for k, v in self._eng.reject_counts.items()}
+
+    @property
+    def phase_walls(self):
+        base = self._base["phase"]
+        return {k: v - base.get(k, 0.0)
+                for k, v in self._eng.phase_walls.items()}
+
+
+# ---------------------------------------------------------------------------
+# the executor
+# ---------------------------------------------------------------------------
+
+class DeviceExecutor:
+    """Process-wide device dispatch service (see module docstring)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._engines = {}                  # config key -> engine
+        self._engine_lock = threading.Lock()
+        self._buckets = OrderedDict()       # fuse key -> [_Unit]
+        self._n_pending = 0
+        self._tenants = {}                  # name -> ref count
+        self._weights = {}                  # name -> DRR weight
+        self._deficit = {}                  # name -> DRR deficit
+        self._inflight = {}                 # name -> device submissions
+        self._dispatcher = None
+        self._shutdown = False
+        self._own_pool = None
+
+    # -- tenancy ------------------------------------------------------------
+    def register_tenant(self, name: str, weight: float = 1.0):
+        name = str(name or "default")
+        with self._cond:
+            self._tenants[name] = self._tenants.get(name, 0) + 1
+            self._weights[name] = max(0.1, float(weight))
+            self._inflight.setdefault(name, 0)
+
+    def release_tenant(self, name: str):
+        name = str(name or "default")
+        with self._cond:
+            n = self._tenants.get(name, 0) - 1
+            if n > 0:
+                self._tenants[name] = n
+            else:
+                self._tenants.pop(name, None)
+                self._weights.pop(name, None)
+                self._deficit.pop(name, None)
+                if not self._inflight.get(name, 0):
+                    self._inflight.pop(name, None)
+            self._cond.notify_all()
+
+    def _fusion_active(self) -> bool:
+        if not fuse_enabled():
+            return False
+        return fuse_forced() or len(self._tenants) >= 2
+
+    # -- engines ------------------------------------------------------------
+    def _make_engine(self, match, mismatch, gap, vcap, pcap, lcap,
+                     kcap, max_depth, banded, mesh):
+        # monkeypatch seam for tests (stub engines)
+        from racon_tpu.tpu.poa import TPUPoaBatchEngine
+
+        return TPUPoaBatchEngine(match, mismatch, gap, vcap=vcap,
+                                 pcap=pcap, lcap=lcap, kcap=kcap,
+                                 max_depth=max_depth, banded=banded,
+                                 mesh=mesh)
+
+    def poa_handle(self, match, mismatch, gap, vcap, pcap, lcap,
+                   kcap, max_depth, banded, mesh, tenant=None,
+                   cap=0) -> PoaEngineHandle:
+        """A shared engine for this config (AOT-shelf shapes are keyed
+        by the same tuple, so sharing also shares warm kernels)."""
+        key = (match, mismatch, gap, vcap, pcap, lcap, kcap,
+               max_depth, bool(banded), _mesh_key(mesh))
+        with self._engine_lock:
+            engine = self._engines.get(key)
+            if engine is None:
+                engine = self._make_engine(match, mismatch, gap, vcap,
+                                           pcap, lcap, kcap, max_depth,
+                                           banded, mesh)
+                self._engines[key] = engine
+        return PoaEngineHandle(self, engine, tenant, cap)
+
+    # -- submissions ---------------------------------------------------------
+    def submit_poa(self, handle: PoaEngineHandle, windows, trim,
+                   pool=None):
+        """Returns a zero-arg collect closure, like the engine's."""
+        engine = handle._eng
+        if not self._fusion_active():
+            return engine.consensus_batch_async(windows, trim,
+                                                pool=pool)
+        key = ("poa", id(engine), bool(trim))
+        unit = _Unit("poa", handle.tenant, list(windows),
+                     len(windows), handle.cap, pool)
+        unit.retry = lambda u: engine.consensus_batch_async(
+            u.payload, trim, pool=u.pool or self._pool())
+        self._enqueue(key, unit, lambda units, pool: (
+            engine.consensus_batch_async(
+                [w for u in units for w in u.payload], trim,
+                pool=pool),
+            sum(u.size for u in units)))
+
+        def collect(u=unit):
+            rows, whole = self._collect_unit(u)
+            return rows if whole else rows[u.lo:u.hi]
+
+        return collect
+
+    def align_wfa(self, queries, targets, lq, emax, mesh=None,
+                  tenant=None):
+        from racon_tpu.tpu import align_pallas
+
+        if not self._fusion_active():
+            return align_pallas.wfa_dispatch(queries, targets, lq,
+                                             emax, mesh=mesh)
+        key = ("wfa", lq, emax, _mesh_key(mesh))
+        unit = _Unit("wfa", tenant, (list(queries), list(targets)),
+                     len(queries), 0, None)
+        unit.retry = lambda u: align_pallas.wfa_dispatch(
+            u.payload[0], u.payload[1], lq, emax, mesh=mesh)
+        self._enqueue(key, unit, lambda units, pool: (
+            align_pallas.wfa_dispatch(
+                [q for u in units for q in u.payload[0]],
+                [t for u in units for t in u.payload[1]],
+                lq, emax, mesh=mesh),
+            sum(u.size for u in units)))
+        return self._align_collect(unit)
+
+    def align_band(self, queries, targets, lq, lt, wb, mesh=None,
+                   centers=None, tenant=None):
+        from racon_tpu.tpu import align_pallas
+
+        if not self._fusion_active():
+            return align_pallas.align_dispatch(queries, targets, lq,
+                                               lt, wb, mesh=mesh,
+                                               centers=centers)
+        key = ("band", lq, lt, wb, _mesh_key(mesh))
+        cent = list(centers) if centers is not None \
+            else [None] * len(queries)
+        unit = _Unit("band", tenant,
+                     (list(queries), list(targets), cent),
+                     len(queries), 0, None)
+        unit.retry = lambda u: align_pallas.align_dispatch(
+            u.payload[0], u.payload[1], lq, lt, wb, mesh=mesh,
+            centers=u.payload[2])
+        self._enqueue(key, unit, lambda units, pool: (
+            align_pallas.align_dispatch(
+                [q for u in units for q in u.payload[0]],
+                [t for u in units for t in u.payload[1]],
+                lq, lt, wb, mesh=mesh,
+                centers=[c for u in units for c in u.payload[2]]),
+            sum(u.size for u in units)))
+        return self._align_collect(unit)
+
+    def _align_collect(self, unit):
+        """Align collects return stacked arrays -- slice this unit's
+        rows back out -- and expose per-unit ``device_s`` prorated by
+        pair share (observability only)."""
+
+        def collect(u=unit):
+            rows, whole = self._collect_unit(u)
+            if whole:
+                return tuple(rows)
+            return tuple(r[u.lo:u.hi] for r in rows)
+
+        def device_s(u=unit):
+            if u.fused is None or not u.fused.n_items:
+                return 0.0
+            return u.fused.device_s() * (u.size / u.fused.n_items)
+
+        collect.device_s = device_s
+        return collect
+
+    # -- queueing + dispatch -------------------------------------------------
+    def _enqueue(self, key, unit, fuse_dispatch):
+        unit.fuse_dispatch = fuse_dispatch
+        with self._cond:
+            self._buckets.setdefault(key, []).append(unit)
+            self._n_pending += 1
+            if self._dispatcher is None or not self._dispatcher.is_alive():
+                self._dispatcher = threading.Thread(
+                    target=self._dispatcher_loop,
+                    name="racon-tpu-executor", daemon=True)
+                self._dispatcher.start()
+            self._cond.notify_all()
+
+    def _collect_unit(self, unit):
+        """Returns ``(rows, whole)``: ``whole`` is True when rows
+        cover only this unit (individual retry path) and False when
+        they are the full fused result the caller must slice."""
+        unit.done.wait()
+        try:
+            return unit.fused.result(), False
+        except _FusedBatchError:
+            # shared attempt failed: this unit stands alone.  Its own
+            # retry failing raises HERE -- in this unit's collect --
+            # and nowhere else.
+            return unit.retry(unit)(), True
+
+    def _occupancy_target(self, units) -> int:
+        cap = max((u.cap for u in units), default=0)
+        return cap if cap > 0 else 0
+
+    def _eligible(self, tenant, quota) -> bool:
+        if quota <= 0 or len(self._tenants) < 2:
+            return True
+        if self._inflight.get(tenant, 0) < quota:
+            return True
+        # work-conserving: at-quota tenants run when nobody else waits
+        others = any(u.tenant != tenant
+                     for us in self._buckets.values() for u in us)
+        return not others
+
+    def _form_batch(self, key):
+        """Weighted deficit-round-robin pick (whole units, total size
+        <= the occupancy target) honoring the in-flight quota.  Called
+        under the lock; removes picked units from the bucket."""
+        units = self._buckets.get(key, [])
+        quota = tenant_quota()
+        target = self._occupancy_target(units)
+        by_tenant = OrderedDict()
+        for u in units:
+            by_tenant.setdefault(u.tenant, []).append(u)
+        picked, total = [], 0
+        quantum = max(1, target or max(u.size for u in units))
+        # credit every eligible tenant once per formation, scaled by
+        # weight; then take ONE unit per tenant per cycle so no tenant
+        # can fill the whole target before the others are visited
+        for tenant in by_tenant:
+            if self._eligible(tenant, quota):
+                self._deficit[tenant] = (
+                    self._deficit.get(tenant, 0.0)
+                    + quantum * self._weights.get(tenant, 1.0))
+        progress = True
+        while progress and by_tenant \
+                and not (target and total >= target):
+            progress = False
+            for tenant in list(by_tenant):
+                if not self._eligible(tenant, quota):
+                    continue
+                queue = by_tenant[tenant]
+                u = queue[0]
+                if picked and target and total + u.size > target:
+                    continue
+                if self._deficit.get(tenant, 0.0) < u.size:
+                    # short on credit this formation; it accrues on
+                    # the next one, so a unit larger than one quantum
+                    # waits rounds, never forever
+                    continue
+                self._deficit[tenant] -= u.size
+                picked.append(queue.pop(0))
+                total += u.size
+                progress = True
+                if not queue:
+                    # classic DRR: an emptied queue forfeits deficit
+                    del by_tenant[tenant]
+                    self._deficit[tenant] = 0.0
+                if target and total >= target:
+                    break
+        if picked:
+            remaining = [u for u in units if u not in picked]
+            if remaining:
+                self._buckets[key] = remaining
+            else:
+                self._buckets.pop(key, None)
+            self._n_pending -= len(picked)
+            for u in picked:
+                self._inflight[u.tenant] = (
+                    self._inflight.get(u.tenant, 0) + 1)
+        return picked, total, target
+
+    def _bucket_ripe(self, key, now) -> bool:
+        units = self._buckets.get(key)
+        if not units:
+            return False
+        head = min(u.t_submit for u in units)
+        if now - head >= fuse_wait_s():
+            return True
+        target = self._occupancy_target(units)
+        if target and sum(u.size for u in units) >= target:
+            return True
+        # every known tenant already queued here: nothing to wait for
+        if len(self._tenants) >= 2 and \
+                {u.tenant for u in units} >= set(self._tenants):
+            return True
+        return False
+
+    def _dispatcher_loop(self):
+        while True:
+            with self._cond:
+                while self._n_pending == 0 and not self._shutdown:
+                    self._cond.wait()
+                if self._shutdown:
+                    return
+                now = _mono()
+                ripe = [k for k in self._buckets
+                        if self._bucket_ripe(k, now)]
+                if not ripe:
+                    heads = [min(u.t_submit for u in us)
+                             for us in self._buckets.values() if us]
+                    wait = (min(heads) + fuse_wait_s() - now) \
+                        if heads else 0.05
+                    self._cond.wait(max(1e-4, min(wait, 0.05)))
+                    continue
+                key = min(ripe, key=lambda k: min(
+                    u.t_submit for u in self._buckets[k]))
+                picked, total, target = self._form_batch(key)
+                if not picked:
+                    # every pending tenant at quota: wait for a
+                    # collect to decrement in-flight
+                    self._cond.wait(0.02)
+                    continue
+            self._dispatch(picked, total, target, now)
+
+    def _dispatch(self, units, total, target, now):
+        tenants = {u.tenant for u in units}
+        lo = 0
+        for u in units:
+            u.lo, u.hi = lo, lo + u.size
+            lo += u.size
+            if u.tenant in self._tenants:
+                REGISTRY.observe(f"serve_tenant_wait_s.{u.tenant}",
+                                 max(0.0, now - u.t_submit))
+        REGISTRY.add("fusion_dispatches")
+        REGISTRY.add("fusion_units_fused", len(units))
+        if len(units) > 1:
+            REGISTRY.add("fused_megabatches")
+            if len(tenants) > 1:
+                REGISTRY.add("fused_cross_tenant")
+        REGISTRY.observe("fusion_occupancy",
+                         total / target if target else 1.0)
+        try:
+            collect, n_items = units[0].fuse_dispatch(
+                units, self._pool())
+            fused = _FusedDispatch(collect, n_items, units)
+        except BaseException as exc:  # containment: fall back per unit
+            fused = _FusedDispatch(_raiser(exc), total, units)
+        # in-flight decrements on completion of the shared device
+        # work: piggyback on the first collect (wrapped BEFORE units
+        # wake so no collect can slip past the accounting)
+        orig_result = fused.result
+        decremented = threading.Event()
+
+        def result():
+            try:
+                return orig_result()
+            finally:
+                if not decremented.is_set():
+                    decremented.set()
+                    with self._cond:
+                        for u in units:
+                            t = u.tenant
+                            if self._inflight.get(t, 0) > 0:
+                                self._inflight[t] -= 1
+                        self._cond.notify_all()
+
+        fused.result = result
+        for u in units:
+            u.fused = fused
+            u.done.set()
+
+    def _pool(self):
+        if self._own_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._own_pool = ThreadPoolExecutor(
+                max_workers=max(2, os.cpu_count() or 2),
+                thread_name_prefix="racon-tpu-exec")
+        return self._own_pool
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._cond:
+            pending = {str(k[0]): sum(u.size for u in us)
+                       for k, us in self._buckets.items() if us}
+            doc = {
+                "enabled": fuse_enabled(),
+                "active": self._fusion_active(),
+                "tenants": dict(self._tenants),
+                "inflight": {k: v for k, v in self._inflight.items()
+                             if v},
+                "pending_units": self._n_pending,
+                "pending_items": pending,
+                "quota": tenant_quota(),
+                "fuse_wait_ms": fuse_wait_s() * 1e3,
+            }
+        for key in ("fusion_dispatches", "fusion_units_fused",
+                    "fused_megabatches", "fused_cross_tenant"):
+            doc[key] = REGISTRY.value(key)
+        return doc
+
+    def close(self):
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+        if self._own_pool is not None:
+            self._own_pool.shutdown(wait=False)
+            self._own_pool = None
+
+
+def _raiser(exc):
+    def collect():
+        raise exc
+    return collect
+
+
+# ---------------------------------------------------------------------------
+# process-wide singleton
+# ---------------------------------------------------------------------------
+
+_EXECUTOR = None
+_EXECUTOR_LOCK = threading.Lock()
+
+
+def get_executor() -> DeviceExecutor:
+    global _EXECUTOR
+    with _EXECUTOR_LOCK:
+        if _EXECUTOR is None:
+            _EXECUTOR = DeviceExecutor()
+        return _EXECUTOR
+
+
+def _reset_for_tests():
+    """Drop the singleton (tests only -- live collects keep working,
+    they hold their own unit/engine references)."""
+    global _EXECUTOR
+    with _EXECUTOR_LOCK:
+        if _EXECUTOR is not None:
+            _EXECUTOR.close()
+        _EXECUTOR = None

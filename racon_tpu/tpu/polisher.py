@@ -341,13 +341,25 @@ class TPUPolisher(Polisher):
                 and self.tpu_poa_batches > 0)
 
     def _make_poa_engine(self):
-        from racon_tpu.tpu.poa import TPUPoaBatchEngine
+        """A handle on the process-wide device executor's shared
+        engine for this scoring/cap config (racon_tpu/tpu/executor).
+        Standalone the handle is a passthrough; under the serve
+        daemon its dispatches fuse with other jobs' compatible
+        batches.  The handle's cap is this polisher's own device
+        batch size -- the executor's fused-batch occupancy target,
+        so sharing never exceeds the memory envelope a single job
+        already sized for."""
+        from racon_tpu.tpu import executor
 
         vcap, lcap = self._poa_caps()
-        return TPUPoaBatchEngine(
+        n_dev = len(self.mesh.devices)
+        cap = min(self._poa_batch_size(vcap, lcap, n_dev),
+                  n_dev * _env_int("RACON_TPU_POA_MEGABATCH", 256))
+        return executor.get_executor().poa_handle(
             self.match, self.mismatch, self.gap, vcap=vcap, pcap=16,
             lcap=lcap, kcap=128, max_depth=self.MAX_DEPTH_PER_WINDOW,
-            banded=self.tpu_banded_alignment, mesh=self.mesh)
+            banded=self.tpu_banded_alignment, mesh=self.mesh,
+            tenant=getattr(self, "_executor_tenant", None), cap=cap)
 
     def _pipeline_begin(self, overlaps: List[Overlap]) -> None:
         """Set up the producer/consumer seam before the align stage:
@@ -1527,10 +1539,17 @@ class TPUPolisher(Polisher):
                       for c0 in range(0, len(idx), max_b)]
 
             def dispatch(sub, emax=emax):
-                return align_pallas.wfa_dispatch(
+                # routed through the process-wide executor: under
+                # serve, compatible rungs from concurrent jobs fuse
+                # into one shared dispatch (per-pair lanes, so the
+                # sliced results are byte-identical to a solo call)
+                from racon_tpu.tpu import executor
+
+                return executor.get_executor().align_wfa(
                     [queries[i] for i in sub],
                     [targets[i] for i in sub], bd, emax,
-                    mesh=self.mesh)
+                    mesh=self.mesh,
+                    tenant=getattr(self, "_executor_tenant", None))
 
             t_rung = _now()     # rung span start: chunk spans nest in
             tally = {"cert": 0, "mark": t_rung}
@@ -1621,12 +1640,15 @@ class TPUPolisher(Polisher):
                       for c0 in range(0, len(idx), max_b)]
 
             def dispatch(sub, wb=wb):
-                return align_pallas.align_dispatch(
+                from racon_tpu.tpu import executor
+
+                return executor.get_executor().align_band(
                     [queries[i] for i in sub],
                     [targets[i] for i in sub],
                     bd, bd, wb, mesh=self.mesh,
                     centers=[emp_knots(i) if i in use_emp else None
-                             for i in sub])
+                             for i in sub],
+                    tenant=getattr(self, "_executor_tenant", None))
 
             t_rung = _now()     # rung span start: chunk spans nest in
             tally = {"cert": 0, "mark": t_rung}

@@ -131,7 +131,8 @@ def host_reserved_workers(n_workers: int, source: str) -> int:
 
 
 def predict_walls(align_s: float, poa_s: float,
-                  overlap_s: float = None) -> dict:
+                  overlap_s: float = None, concurrency: int = 1,
+                  occupancy: float = None) -> dict:
     """Overlap-aware wall predictor for the two-stage polish.
 
     The pre-r8 budget model was additive (wall ~ align + poa): the
@@ -142,7 +143,19 @@ def predict_walls(align_s: float, poa_s: float,
     windows are complete).  ``overlap_s`` is the measured
     pipeline_overlap_s when available; without it only the bounds are
     returned.  ``overlap_efficiency`` is the achieved fraction of the
-    maximum hideable wall min(align, poa)."""
+    maximum hideable wall min(align, poa).
+
+    ``concurrency`` > 1 adds the r13 fused-batch sharing price,
+    ``shared_wall_s``: under N concurrent tenants the device-resident
+    floor serializes through the process-wide executor's shared FIFO,
+    so each extra tenant adds up to one floor of contention --
+    discounted by the measured mean fusion ``occupancy`` (a full
+    shared megabatch carries several tenants' windows in ONE dispatch,
+    so at occupancy 1.0 the contention term halves; at 0 fusion buys
+    nothing and sharing degenerates to pure serialization).  Like the
+    rest of the admission price this is deliberately crude -- it only
+    has to keep ``RACON_TPU_SERVE_MAX_WALL_S`` honest to the right
+    order of magnitude when jobs share the device."""
     out = {
         "additive_wall_s": round(align_s + poa_s, 3),
         "overlapped_floor_s": round(max(align_s, poa_s), 3),
@@ -155,6 +168,15 @@ def predict_walls(align_s: float, poa_s: float,
         hideable = min(align_s, poa_s)
         out["overlap_efficiency"] = round(
             overlap_s / hideable, 3) if hideable > 0 else 0.0
+    n = max(1, int(concurrency))
+    if n > 1:
+        occ = min(1.0, max(0.0, occupancy or 0.0))
+        base = out.get("predicted_wall_s", out["additive_wall_s"])
+        gain = 1.0 + occ
+        out["shared_wall_s"] = round(
+            base + (n - 1) * out["overlapped_floor_s"] / gain, 3)
+        out["shared_concurrency"] = n
+        out["fusion_occupancy"] = round(occ, 3)
     return out
 
 

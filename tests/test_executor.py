@@ -1,0 +1,321 @@
+"""Cross-job device executor (ISSUE r13, racon_tpu/tpu/executor.py).
+
+The executor inverts device-FIFO ownership -- from per-job polisher
+to a process-wide service that fuses concurrent jobs' compatible
+megabatches -- so the contract to pin is threefold:
+
+* **byte identity** -- three concurrent jobs polished through the
+  scheduler with fusion ON produce EXACTLY the bytes the same jobs
+  produce with fusion OFF (and therefore the bytes a standalone run
+  produces: the off path IS the pre-executor passthrough).  Fusion
+  may only change batch composition on the device, never any job's
+  results or their order.
+* **fairness** -- weighted deficit-round-robin plus the per-tenant
+  in-flight quota (``RACON_TPU_SERVE_TENANT_QUOTA``): a large tenant
+  streaming an arbitrary backlog cannot starve a small tenant past
+  its quota, and an at-quota tenant alone keeps running (the quota
+  is work-conserving).
+* **crash containment** -- a poisoned unit inside a fused batch
+  fails ONLY its own job: batchmates transparently retry
+  individually and succeed.
+
+Fairness and containment run against a stub engine so the dispatch
+order and failure site are deterministic; byte identity runs the
+real CPU-backend polisher end to end.
+"""
+
+import threading
+import time
+
+import pytest
+
+from racon_tpu.tpu import executor as ex_mod
+from racon_tpu.tpu.executor import (DeviceExecutor, PoaEngineHandle,
+                                    _FusedBatchError)
+
+
+@pytest.fixture(autouse=True)
+def fresh_executor(monkeypatch):
+    # the fusion CI lane (ci/cpu/fusion_tier1.sh) pins
+    # RACON_TPU_FUSE_FORCE=1 process-wide; these unit tests pin the
+    # passthrough/off paths too, so they manage the knob themselves
+    monkeypatch.delenv("RACON_TPU_FUSE_FORCE", raising=False)
+    ex_mod._reset_for_tests()
+    yield
+    ex_mod._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# stub engine: deterministic, records every dispatched batch
+# ---------------------------------------------------------------------------
+
+class StubEngine:
+    device_s = 0.0
+    cells = 0
+    n_rounds = 0
+    n_skipped_layers = 0
+
+    def __init__(self, poison=None, poison_at="dispatch"):
+        self.reject_counts = {}
+        self.phase_walls = {}
+        self.batches = []
+        self.lock = threading.Lock()
+        self.poison = poison
+        self.poison_at = poison_at
+
+    def will_dispatch_async(self, windows):
+        return False
+
+    def consensus_batch_async(self, windows, trim, pool=None):
+        windows = list(windows)
+        if self.poison in windows and self.poison_at == "dispatch":
+            raise RuntimeError("poisoned window at dispatch")
+        with self.lock:
+            self.batches.append(windows)
+        out = [("res", w) for w in windows]
+        if self.poison in windows and self.poison_at == "collect":
+            def bad():
+                raise RuntimeError("poisoned window at collect")
+            return bad
+        return lambda: out
+
+
+def _handle(ex, eng, tenant, cap=0):
+    return PoaEngineHandle(ex, eng, tenant, cap)
+
+
+# ---------------------------------------------------------------------------
+# fusion mechanics
+# ---------------------------------------------------------------------------
+
+def test_two_tenants_fuse_into_one_dispatch(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_FUSE_WAIT_MS", "200")
+    monkeypatch.delenv("RACON_TPU_FUSE", raising=False)
+    ex = DeviceExecutor()
+    eng = StubEngine()
+    ex.register_tenant("a")
+    ex.register_tenant("b")
+    try:
+        ha = _handle(ex, eng, "a", cap=8)
+        hb = _handle(ex, eng, "b", cap=8)
+        ca = ex.submit_poa(ha, ["a1", "a2"], True)
+        cb = ex.submit_poa(hb, ["b1"], True)
+        assert ca() == [("res", "a1"), ("res", "a2")]
+        assert cb() == [("res", "b1")]
+    finally:
+        ex.close()
+    # one shared dispatch carried both tenants' units, demuxed by
+    # slice -- each tenant saw only its own results, in its own order
+    assert len(eng.batches) == 1
+    assert sorted(eng.batches[0]) == ["a1", "a2", "b1"]
+
+
+def test_single_tenant_is_passthrough():
+    ex = DeviceExecutor()
+    eng = StubEngine()
+    # no registered tenants (the standalone CLI): the call must go
+    # straight through on the calling thread
+    h = _handle(ex, eng, None)
+    coll = ex.submit_poa(h, ["w1"], True)
+    assert coll() == [("res", "w1")]
+    assert len(eng.batches) == 1
+    assert ex._dispatcher is None  # dispatcher thread never started
+    ex.close()
+
+
+def test_fuse_off_switch(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_FUSE", "0")
+    ex = DeviceExecutor()
+    eng = StubEngine()
+    ex.register_tenant("a")
+    ex.register_tenant("b")
+    coll = ex.submit_poa(_handle(ex, eng, "a"), ["w1"], True)
+    assert coll() == [("res", "w1")]
+    assert ex._dispatcher is None
+    ex.close()
+
+
+def test_handle_counters_are_deltas():
+    ex = DeviceExecutor()
+    eng = StubEngine()
+    eng.reject_counts = {-1: 5}
+    h = _handle(ex, eng, None)
+    eng.reject_counts = {-1: 7, -2: 1}
+    assert h.reject_counts == {-1: 2, -2: 1}
+    ex.close()
+
+
+# ---------------------------------------------------------------------------
+# fairness: DRR + in-flight quota
+# ---------------------------------------------------------------------------
+
+def _seed_bucket(ex, eng, units):
+    """Place units directly in a bucket (no dispatcher thread) so
+    _form_batch's pick is deterministic under test."""
+    from racon_tpu.tpu.executor import _Unit
+
+    key = ("poa", id(eng), True)
+    made = []
+    for tenant, size, cap in units:
+        u = _Unit("poa", tenant, [f"{tenant}{i}" for i in range(size)],
+                  size, cap, None)
+        made.append(u)
+        ex._buckets.setdefault(key, []).append(u)
+        ex._n_pending += 1
+    return key, made
+
+
+def test_quota_blocks_saturated_tenant(monkeypatch):
+    """A large tenant at its in-flight quota yields the batch to the
+    small tenant -- the starvation bound the quota exists for."""
+    monkeypatch.setenv("RACON_TPU_SERVE_TENANT_QUOTA", "1")
+    ex = DeviceExecutor()
+    eng = StubEngine()
+    ex.register_tenant("big")
+    ex.register_tenant("small")
+    ex._inflight["big"] = 1          # big already has a batch in flight
+    key, units = _seed_bucket(
+        ex, eng, [("big", 8, 8), ("big", 8, 8), ("small", 2, 8)])
+    picked, total, _ = ex._form_batch(key)
+    assert [u.tenant for u in picked] == ["small"]
+    # big's units stay queued, not dropped
+    assert sum(1 for u in ex._buckets[key] if u.tenant == "big") == 2
+    ex.close()
+
+
+def test_quota_is_work_conserving(monkeypatch):
+    """Alone in the queue, an at-quota tenant still runs -- the quota
+    only redistributes, it never idles the device."""
+    monkeypatch.setenv("RACON_TPU_SERVE_TENANT_QUOTA", "1")
+    ex = DeviceExecutor()
+    eng = StubEngine()
+    ex.register_tenant("big")
+    ex.register_tenant("other")      # registered but nothing pending
+    ex._inflight["big"] = 3
+    key, _ = _seed_bucket(ex, eng, [("big", 4, 8)])
+    picked, _, _ = ex._form_batch(key)
+    assert [u.tenant for u in picked] == ["big"]
+    ex.close()
+
+
+def test_drr_shares_batch_across_tenants():
+    """With both tenants under quota the fused batch takes work from
+    each (deficit-round-robin), bounded by the occupancy target."""
+    ex = DeviceExecutor()
+    eng = StubEngine()
+    ex.register_tenant("a")
+    ex.register_tenant("b")
+    key, _ = _seed_bucket(
+        ex, eng, [("a", 4, 8), ("a", 4, 8), ("a", 4, 8), ("b", 4, 8)])
+    picked, total, target = ex._form_batch(key)
+    assert total <= target == 8
+    assert {u.tenant for u in picked} == {"a", "b"}
+    ex.close()
+
+
+def test_large_job_cannot_starve_small_tenant(monkeypatch):
+    """End to end: a tenant streaming a big backlog and a small tenant
+    submitting one unit -- the small tenant's collect completes even
+    though the big tenant's backlog never drains below the quota."""
+    monkeypatch.setenv("RACON_TPU_SERVE_TENANT_QUOTA", "1")
+    monkeypatch.setenv("RACON_TPU_FUSE_WAIT_MS", "5")
+    monkeypatch.delenv("RACON_TPU_FUSE", raising=False)
+    ex = DeviceExecutor()
+    eng = StubEngine()
+    ex.register_tenant("big")
+    ex.register_tenant("small")
+    try:
+        hb = _handle(ex, eng, "big", cap=4)
+        hs = _handle(ex, eng, "small", cap=4)
+        big_colls = [ex.submit_poa(hb, [f"big{i}"], True)
+                     for i in range(16)]
+        small = ex.submit_poa(hs, ["small0"], True)
+        t0 = time.monotonic()
+        assert small() == [("res", "small0")]
+        # bounded wait: well under the time 16 serialized big batches
+        # would take if the small unit had to queue behind them all
+        assert time.monotonic() - t0 < 5.0
+        for i, c in enumerate(big_colls):
+            assert c() == [("res", f"big{i}")]
+    finally:
+        ex.close()
+
+
+# ---------------------------------------------------------------------------
+# crash containment
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("poison_at", ["dispatch", "collect"])
+def test_poisoned_unit_fails_only_its_job(monkeypatch, poison_at):
+    monkeypatch.setenv("RACON_TPU_FUSE_WAIT_MS", "200")
+    monkeypatch.delenv("RACON_TPU_FUSE", raising=False)
+    ex = DeviceExecutor()
+    eng = StubEngine(poison="bad", poison_at=poison_at)
+    for t in ("a", "b", "c"):
+        ex.register_tenant(t)
+    try:
+        ca = ex.submit_poa(_handle(ex, eng, "a", cap=16),
+                           ["a1", "a2"], True)
+        cb = ex.submit_poa(_handle(ex, eng, "b", cap=16),
+                           ["bad"], True)
+        cc = ex.submit_poa(_handle(ex, eng, "c", cap=16),
+                           ["c1"], True)
+        # healthy tenants succeed via individual retry ...
+        assert ca() == [("res", "a1"), ("res", "a2")]
+        assert cc() == [("res", "c1")]
+        # ... only the poisoned tenant's collect raises
+        with pytest.raises(RuntimeError, match="poisoned"):
+            cb()
+    finally:
+        ex.close()
+
+
+def test_fused_error_wrapper_preserves_cause():
+    err = _FusedBatchError(ValueError("boom"))
+    assert isinstance(err.cause, ValueError)
+
+
+# ---------------------------------------------------------------------------
+# byte identity: three concurrent jobs, fusion on vs off
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    from racon_tpu.tools import simulate
+
+    tmp = str(tmp_path_factory.mktemp("exec_data"))
+    return simulate.simulate(tmp, genome_len=8_000, coverage=5,
+                             read_len=800, seed=21, ont=True)
+
+
+def _concurrent_fastas(dataset, n_jobs, fuse, monkeypatch):
+    from racon_tpu.serve.scheduler import JobScheduler
+    from racon_tpu.serve.session import run_job
+
+    reads, paf, draft = dataset
+    monkeypatch.setenv("RACON_TPU_FUSE", "1" if fuse else "0")
+    monkeypatch.setenv("RACON_TPU_FUSE_WAIT_MS", "20")
+    ex_mod._reset_for_tests()
+    sched = JobScheduler(run_job, max_queue=n_jobs, max_jobs=n_jobs)
+    try:
+        jobs = [sched.submit({
+            "sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 2, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1, "tenant": f"t{i}"})
+            for i in range(n_jobs)]
+        for j in jobs:
+            assert j.done.wait(300)
+    finally:
+        sched.drain(timeout=60)
+    for j in jobs:
+        assert j.result.get("ok"), j.result
+    return [j.result["fasta_b64"] for j in jobs]
+
+
+def test_fusion_on_off_byte_identity_three_jobs(dataset, monkeypatch):
+    fused = _concurrent_fastas(dataset, 3, True, monkeypatch)
+    plain = _concurrent_fastas(dataset, 3, False, monkeypatch)
+    # same input => every job identical, fused or not; the OFF path is
+    # the pre-executor passthrough, so this IS standalone equivalence
+    assert fused == plain
+    assert len(set(fused)) == 1

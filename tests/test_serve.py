@@ -230,6 +230,54 @@ def test_serve_concurrent_jobs_byte_identical(main_server, dataset,
     assert results[0]["job_id"] != results[1]["job_id"]
 
 
+def test_serve_four_fused_jobs_byte_identical(serve_tmp, dataset,
+                                              golden):
+    """The r13 acceptance pin: four concurrent small jobs on a
+    4-worker daemon with cross-job fusion ON (distinct tenants, short
+    fusion window forced so batches really fuse) each return EXACTLY
+    the one-shot CLI's bytes, and the daemon's telemetry shows the
+    fused executor active."""
+    proc, sock_path = _start_server(
+        serve_tmp, "fused", args=("--jobs", "4"),
+        extra_env={"RACON_TPU_FUSE": "1",
+                   "RACON_TPU_FUSE_WAIT_MS": "20"})
+    try:
+        results = [None] * 4
+
+        def run(slot):
+            spec = dict(_spec(dataset))
+            spec["tenant"] = f"tenant{slot}"
+            results[slot] = client.submit(sock_path, spec)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, resp in enumerate(results):
+            assert resp["ok"], resp
+            assert base64.b64decode(resp["fasta_b64"]) == golden, (
+                f"fused concurrent job {i} diverged from the "
+                "one-shot CLI bytes")
+        assert len({r["job_id"] for r in results}) == 4
+        # fusion stats surface in the telemetry frame
+        tel = client.metrics(sock_path)
+        assert tel["ok"]
+        assert tel["fusion"]["enabled"] is True
+        assert tel["fusion"]["fusion_dispatches"] >= 1
+    finally:
+        if proc.poll() is None:
+            try:
+                client.admin(sock_path, "shutdown")
+            except client.ServeError:
+                proc.terminate()
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def test_serve_crash_containment(main_server, dataset, golden):
     """A malformed job fails structurally; the daemon keeps serving
     warm jobs afterwards."""
