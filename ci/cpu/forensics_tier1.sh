@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# Forensics tier-1 (ISSUE 10 / r14 CI satellite): the flight
+# recorder is ALWAYS ON by design, so this lane proves that posture
+# is safe and that the crash story actually works:
+#
+#   1. obs lint, extended: raw time.monotonic()/perf_counter()/
+#      time.time() anywhere in racon_tpu/ OUTSIDE racon_tpu/obs/,
+#      utils/logger.py and tools/wrapper.py (scratch-file stamps
+#      only) fails the leg -- the flight recorder's timestamps ride
+#      the trace epoch (racon_tpu/obs/trace.py), and nothing may
+#      grow a second timing story next to it.  In-suite twin:
+#      tests/test_obs.py::test_no_raw_timing_outside_obs.
+#   2. the FULL tier-1 suite with the flight recorder pinned on and
+#      a ring small enough to wrap constantly (so eviction runs on
+#      every code path), under PYTHONDEVMODE=1 -- any byte break,
+#      resource leak or hot-path surprise from always-on recording
+#      fails the whole suite, including every byte-identity golden.
+#   3. crash-dump smoke: a worker-thread crash with the dump hooks
+#      installed must leave a parseable flight dump carrying the
+#      "crash" event + traceback, and `racon-tpu inspect --dump`
+#      must render it.  This is the avionics claim -- "what
+#      happened?" has an answer when nobody was watching.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+echo "[forensics_tier1] lint: raw timing outside racon_tpu/obs"
+bad=$(grep -rnE 'time\.monotonic\(|time\.perf_counter\(|time\.time\(' \
+        --include='*.py' racon_tpu/ \
+      | grep -v '^racon_tpu/obs/' \
+      | grep -v '^racon_tpu/utils/logger\.py' \
+      | grep -v '^racon_tpu/tools/wrapper\.py' || true)
+if [ -n "$bad" ]; then
+    echo "[forensics_tier1] FAIL: raw timing outside the obs layer" \
+         "(use racon_tpu.obs.now()/span()):"
+    echo "$bad"
+    exit 1
+fi
+echo "[forensics_tier1] lint clean"
+
+ci/common/build.sh
+export RACON_TPU_FLIGHT=1
+export RACON_TPU_FLIGHT_RING=64
+export PYTHONDEVMODE=1
+python -m pytest tests/ -q -m "not slow" \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"
+
+echo "[forensics_tier1] crash-dump smoke"
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+dump="$work/crash.json"
+JAX_PLATFORMS=cpu python - "$dump" <<'EOF'
+import sys
+import threading
+
+from racon_tpu.obs import flight
+
+flight.FLIGHT.install_dump_on_crash(sys.argv[1])
+
+def boom():
+    raise ValueError("forensics smoke: uncaught in worker thread")
+
+t = threading.Thread(target=boom, name="crashy")
+t.start()
+t.join()
+EOF
+JAX_PLATFORMS=cpu python - "$dump" <<'EOF'
+import sys
+
+from racon_tpu.obs import flight
+
+doc = flight.load_dump(sys.argv[1])
+assert doc["reason"] == "crash", doc["reason"]
+(ev,) = [e for e in doc["events"] if e["kind"] == "crash"]
+assert "forensics smoke" in ev["error"], ev
+assert "ValueError" in ev["traceback"]
+print("[forensics_tier1] crash dump ok:", ev["error"])
+EOF
+JAX_PLATFORMS=cpu python -m racon_tpu.cli inspect --dump "$dump" \
+    | grep -q '\[crash\]'
+echo "[forensics_tier1] inspect --dump renders the crash marker"

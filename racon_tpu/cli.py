@@ -30,15 +30,20 @@ USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequenc
        racon-tpu submit --socket PATH [options ...] <sequences> <overlaps> <target sequences>
        racon-tpu status --socket PATH [--json]
        racon-tpu top --socket PATH [--interval S] [--once] [--json]
+       racon-tpu inspect (--socket PATH | --dump FILE) [--job N] [--json]
 
     subcommands (racon_tpu/serve — persistent polishing service):
         serve    start the warm-kernel job daemon on a unix socket
         submit   run one polish through a daemon (same options and
-                 stdout contract as the one-shot form)
+                 stdout contract as the one-shot form; --trace FILE
+                 saves the job's server-side trace slice)
         status   print a daemon's queue/registry/provenance snapshot
                  (--json for the raw document)
         top      live telemetry view over the daemon's watch stream
                  (--once --json for one machine-readable frame)
+        inspect  render a job's timeline (queue wait, exec, fused
+                 dispatches with occupancy) from a live daemon's
+                 flight recorder or a post-mortem flight dump
 
 
     #default output is stdout
@@ -246,6 +251,9 @@ def main(argv=None):
     if argv and argv[0] == "top":
         from racon_tpu.serve import top as serve_top
         raise SystemExit(serve_top.main(argv[1:]))
+    if argv and argv[0] == "inspect":
+        from racon_tpu.serve import inspect as serve_inspect
+        raise SystemExit(serve_inspect.main(argv[1:]))
     try:
         opts, inputs = parse_args(argv)
     except ValueError as exc:
@@ -259,10 +267,21 @@ def main(argv=None):
         raise SystemExit(1)
 
     from racon_tpu import obs
+    from racon_tpu.obs import flight as obs_flight
     if opts["trace"]:
         # exported to the environment too, so every module (and the
         # prewarm threads spawned below) sees one switch
         obs.enable_trace(opts["trace"])
+    # one-shot flight recording: only persisted when an explicit dump
+    # path is configured (a default-on dump would litter TMPDIR on
+    # every CLI run); the crash hook still dumps on an unhandled
+    # exception so a dying run leaves its record
+    flight_dump = os.environ.get("RACON_TPU_FLIGHT_DUMP")
+    if flight_dump and obs_flight.enabled():
+        obs_flight.FLIGHT.install_dump_on_crash(flight_dump)
+    obs_flight.FLIGHT.record(
+        "run", inputs=[os.path.basename(p) for p in inputs[:3]],
+        threads=opts["threads"])
 
     if opts["tpu_poa_batches"] > 0 or opts["tpu_aligner_batches"] > 0:
         # kick off the AOT-shelf prewarm NOW, before the (multi-second)
@@ -334,6 +353,16 @@ def main(argv=None):
         path = obs.write_trace()
         print(f"[racon_tpu::] trace written to {path} "
               "(open in Perfetto / chrome://tracing)",
+              file=sys.stderr)
+    # the flight ring must be persisted HERE, before the hard exit
+    # below skips interpreter teardown (same bug class as the stdout
+    # text-layer flush above): an os._exit would otherwise discard
+    # the buffered events with no dump written
+    if flight_dump and obs_flight.enabled():
+        obs_flight.FLIGHT.record("run_done",
+                                 n_sequences=len(polished))
+        path = obs_flight.FLIGHT.dump(flight_dump, reason="run_done")
+        print(f"[racon_tpu::] flight dump written to {path}",
               file=sys.stderr)
     # hard-exit once the output is flushed: background prewarm
     # compiles may still be in flight, and waiting for them (or

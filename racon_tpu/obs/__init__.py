@@ -24,6 +24,14 @@ dispatch) whose timing story needs first-class tooling:
 * :mod:`racon_tpu.obs.provenance` — per-run environment provenance
   (resolved ``RACON_TPU_*`` knobs, jax backend, host-capability
   probe) and the ``--metrics-json`` run-report writer.
+* :mod:`racon_tpu.obs.context` — request-scoped job identity
+  (``job_id``/``tenant``/``trace_id`` contextvar) entered by the
+  serve scheduler around each job; the tracer, flight recorder and
+  logger auto-tag whatever is recorded under it.
+* :mod:`racon_tpu.obs.flight` — an always-on bounded ring of
+  structured events (admits, rejects, fused dispatches, errors with
+  tracebacks), dumped on crash/drain and readable live over the
+  serve socket — crash forensics for the daemon.
 
 Determinism contract: clocks here feed ONLY the trace and the
 metrics, never control flow — a tracing-enabled run emits
@@ -37,7 +45,10 @@ ci/cpu/obs_tier1.sh and tests/test_obs.py fails on raw
 
 from __future__ import annotations
 
+from racon_tpu.obs.context import (JobContext, current, job_context,
+                                   jobs_for_tenant)
 from racon_tpu.obs.devutil import DEVICE_UTIL, DeviceUtil
+from racon_tpu.obs.flight import FLIGHT, FlightRecorder
 from racon_tpu.obs.metrics import (HIST_BUCKETS, REGISTRY, MetricAttr,
                                    Registry, hist_quantile)
 from racon_tpu.obs.trace import (TRACER, device_span, enable_trace, now,
@@ -47,4 +58,6 @@ __all__ = [
     "REGISTRY", "Registry", "MetricAttr", "TRACER",
     "HIST_BUCKETS", "hist_quantile", "DEVICE_UTIL", "DeviceUtil",
     "now", "span", "device_span", "enable_trace", "write_trace",
+    "JobContext", "job_context", "current", "jobs_for_tenant",
+    "FLIGHT", "FlightRecorder",
 ]

@@ -1,0 +1,108 @@
+"""Request-scoped job context: who is this work for?
+
+PR 4's tracer attributes spans to the RECORDING THREAD and PR 8's
+telemetry aggregates over the whole process — neither can answer
+"what happened to job 17?" once the serve daemon runs concurrent
+jobs whose megabatches fuse (racon_tpu/tpu/executor.py).  This
+module is the missing identity layer:
+
+* a :mod:`contextvars` context var carrying ``(job_id, tenant,
+  trace_id)``.  The scheduler worker enters it around one job's
+  execution (:func:`job_context`), so everything recorded on that
+  thread — trace spans/instants (racon_tpu/obs/trace.py auto-tags
+  them), flight-recorder events (racon_tpu/obs/flight.py), logger
+  lines (utils/logger.py prefixes them) — is attributable to the
+  job without any call-site plumbing;
+* a tenant → active-jobs registry for the threads a contextvar
+  cannot reach: the device executor's dispatcher thread fuses units
+  submitted by many tenants' pool threads, and
+  :func:`jobs_for_tenant` lets it stamp the fused dispatch with the
+  job ids that rode it (the Perfetto flow-event / flight-recorder
+  "whose work was this" answer).
+
+The context is observability-only: nothing in the polish pipeline
+reads it to make a decision, so context-on runs stay byte-identical
+to context-off runs (the determinism contract of
+racon_tpu/obs/__init__.py, pinned in tests/test_flight.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import List, NamedTuple, Optional
+
+
+class JobContext(NamedTuple):
+    job_id: int
+    tenant: str
+    trace_id: str
+
+
+_current: ContextVar = ContextVar("racon_tpu_job_context",
+                                  default=None)
+
+_lock = threading.Lock()
+#: tenant -> the JobContexts currently inside :func:`job_context`
+#: (a tenant may have several jobs in flight; newest last)
+_by_tenant: dict = {}
+
+
+def make_trace_id(job_id) -> str:
+    """Deterministic per-process trace id: pid + job id.  A fleet
+    router prefixing its own hop id keeps these unique across
+    daemons without any randomness (nothing here may perturb
+    reproducibility)."""
+    return f"{os.getpid():08x}-{int(job_id):06d}"
+
+
+def current() -> Optional[JobContext]:
+    """The active job context on this thread (None outside a job)."""
+    return _current.get()
+
+
+@contextmanager
+def job_context(job_id, tenant: str = "default",
+                trace_id: str = None):
+    """Enter a job's context for the calling thread.  Nests: an
+    inner context shadows the outer one until it exits."""
+    ctx = JobContext(int(job_id), str(tenant or "default"),
+                     trace_id or make_trace_id(job_id))
+    token = _current.set(ctx)
+    with _lock:
+        _by_tenant.setdefault(ctx.tenant, []).append(ctx)
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+        with _lock:
+            stack = _by_tenant.get(ctx.tenant)
+            if stack and ctx in stack:
+                stack.remove(ctx)
+                if not stack:
+                    del _by_tenant[ctx.tenant]
+
+
+def jobs_for_tenant(tenant) -> List[int]:
+    """Job ids currently executing under ``tenant`` — the
+    cross-thread attribution path for the device executor's
+    dispatcher (contextvars do not cross thread boundaries)."""
+    with _lock:
+        return [c.job_id
+                for c in _by_tenant.get(str(tenant or "default"), ())]
+
+
+def tag_args(args: dict = None) -> Optional[dict]:
+    """Merge the active context's identity into a trace ``args``
+    dict (explicit keys win).  Returns ``args`` unchanged when no
+    context is active — zero-cost for standalone runs."""
+    ctx = _current.get()
+    if ctx is None:
+        return args
+    tagged = {"job": ctx.job_id, "tenant": ctx.tenant,
+              "trace_id": ctx.trace_id}
+    if args:
+        tagged.update(args)
+    return tagged

@@ -1,0 +1,222 @@
+"""Always-on flight recorder: the last N structured events, cheap
+enough to never turn off.
+
+The serve daemon's failure story before r14: a crash (OOM-killed
+worker, unhandled exception, operator SIGTERM mid-queue) left ZERO
+record of what the daemon was doing — the trace buffer only exists
+when ``--trace`` was passed up front, logs interleave and rotate
+away, and metrics are aggregates.  A flight recorder fixes this the
+way avionics do: a bounded ring of the most recent structured events
+(submit/admit/reject with predicted walls, queue transitions,
+fused-dispatch summaries, errors with tracebacks), appended O(1)
+under one lock, no filesystem and no clock-driven control flow on
+the hot path.  The ring is dumped to disk when something goes wrong
+(unhandled exception via the installed hooks, SIGTERM drain, idle
+shutdown) and is readable live through the serve socket's ``flight``
+op — so "what happened?" has an answer even when nobody was
+watching.
+
+Events are dicts with a stable envelope::
+
+    {"seq": 412, "t": 17.003215, "kind": "admit",
+     "job": 17, "tenant": "tenantA", ...kind-specific fields}
+
+``t`` is seconds since the trace epoch (racon_tpu/obs/trace.py), so
+flight events and trace spans interleave on one timebase — the
+``inspect`` subcommand renders both from either source.
+
+Knobs (registered in provenance.KNOWN_KNOBS):
+
+* ``RACON_TPU_FLIGHT``      — "0" disables recording (default on)
+* ``RACON_TPU_FLIGHT_RING`` — ring capacity in events (default 4096)
+* ``RACON_TPU_FLIGHT_DUMP`` — dump path; the daemon defaults to
+  ``$TMPDIR/racon-tpu-flight-<pid>.json``, the one-shot CLI only
+  dumps when this is set explicitly
+
+Determinism: recording is observability-only — a flight-on run emits
+byte-identical polish output to a flight-off run (pinned in
+tests/test_flight.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import traceback
+from collections import deque
+
+from racon_tpu.obs import context as _context
+from racon_tpu.obs import trace as _trace
+
+SCHEMA = "racon-tpu-flight-v1"
+
+_DEF_RING = 4096
+_TB_LIMIT = 8000          # bytes of traceback kept per error event
+
+
+def enabled() -> bool:
+    return os.environ.get("RACON_TPU_FLIGHT", "1") != "0"
+
+
+def ring_size() -> int:
+    try:
+        n = int(os.environ.get("RACON_TPU_FLIGHT_RING", "") or _DEF_RING)
+    except ValueError:
+        n = _DEF_RING
+    return max(16, n)
+
+
+def default_dump_path() -> str:
+    """Where a dump lands when no explicit path was configured."""
+    return (os.environ.get("RACON_TPU_FLIGHT_DUMP")
+            or os.path.join(tempfile.gettempdir(),
+                            f"racon-tpu-flight-{os.getpid()}.json"))
+
+
+class FlightRecorder:
+    """Bounded ring of structured events.  All methods are
+    thread-safe; :meth:`record` is the hot path and does one deque
+    append under the lock."""
+
+    def __init__(self, maxlen: int = None):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=maxlen or ring_size())
+        self._seq = 0
+        self._dropped = 0
+        self._dumped_to = None
+        self._hooks_installed = False
+
+    # -- recording -----------------------------------------------------
+
+    def record(self, kind: str, job=None, tenant=None,
+               **fields) -> None:
+        """Append one event.  ``job``/``tenant`` default from the
+        active job context (racon_tpu/obs/context.py) so call sites
+        inside a job need no plumbing."""
+        if not enabled():
+            return
+        ctx = _context.current()
+        if ctx is not None:
+            if job is None:
+                job = ctx.job_id
+            if tenant is None:
+                tenant = ctx.tenant
+        ev = {"kind": kind, "t": round(
+            _trace.epoch_offset(_trace.now()), 6)}
+        if job is not None:
+            ev["job"] = int(job)
+        if tenant is not None:
+            ev["tenant"] = str(tenant)
+        for k, v in fields.items():
+            if v is not None:
+                ev[k] = v
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+            self._ring.append(ev)
+
+    def record_exception(self, kind: str, exc: BaseException,
+                         **fields) -> None:
+        """An error event carrying a size-bounded traceback."""
+        tb = "".join(traceback.format_exception(
+            type(exc), exc, exc.__traceback__))
+        self.record(kind, error=f"{type(exc).__name__}: {exc}",
+                    traceback=tb[-_TB_LIMIT:], **fields)
+
+    # -- reading -------------------------------------------------------
+
+    def snapshot(self, job=None, last: int = 0) -> list:
+        """Copies of ring events, oldest first; ``job`` filters to
+        events tagged with (or spanning, via a ``jobs`` list) that
+        job; ``last`` keeps only the newest N after filtering."""
+        with self._lock:
+            evs = [dict(ev) for ev in self._ring]
+        if job is not None:
+            job = int(job)
+            evs = [ev for ev in evs
+                   if ev.get("job") == job
+                   or job in ev.get("jobs", ())]
+        if last and last > 0:
+            evs = evs[-last:]
+        return evs
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"enabled": enabled(), "size": len(self._ring),
+                    "capacity": self._ring.maxlen,
+                    "recorded": self._seq, "dropped": self._dropped}
+
+    # -- dumping -------------------------------------------------------
+
+    def dump(self, path: str = None, reason: str = "manual") -> str:
+        """Write the ring to ``path`` (atomic replace) as one
+        self-describing JSON document.  Returns the path written."""
+        path = path or default_dump_path()
+        doc = {"schema": SCHEMA, "pid": os.getpid(),
+               "reason": reason, "ring": self.stats(),
+               "events": self.snapshot()}
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+        self._dumped_to = path
+        return path
+
+    def install_dump_on_crash(self, path: str = None) -> None:
+        """Chain sys.excepthook and threading.excepthook so an
+        unhandled exception in any thread dumps the ring before the
+        previous hook runs.  Idempotent."""
+        if self._hooks_installed:
+            return
+        self._hooks_installed = True
+
+        def _dump(exc):
+            try:
+                self.record_exception("crash", exc)
+                p = self.dump(path, reason="crash")
+                print(f"[racon-tpu] flight dump: {p}",
+                      file=sys.stderr)
+            except Exception:
+                pass
+
+        prev_sys = sys.excepthook
+
+        def _sys_hook(tp, val, tb):
+            _dump(val)
+            prev_sys(tp, val, tb)
+
+        sys.excepthook = _sys_hook
+
+        prev_thr = threading.excepthook
+
+        def _thr_hook(hook_args):
+            if hook_args.exc_value is not None:
+                _dump(hook_args.exc_value)
+            prev_thr(hook_args)
+
+        threading.excepthook = _thr_hook
+
+
+FLIGHT = FlightRecorder()
+
+
+def _reset_for_tests() -> None:
+    """Fresh singleton (re-reads RACON_TPU_FLIGHT_RING)."""
+    global FLIGHT
+    FLIGHT = FlightRecorder()
+
+
+def load_dump(path: str) -> dict:
+    """Parse a flight dump, validating the schema marker."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != SCHEMA:
+        raise ValueError(
+            f"{path}: not a flight dump (schema="
+            f"{doc.get('schema')!r})")
+    return doc

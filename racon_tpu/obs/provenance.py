@@ -75,6 +75,13 @@ KNOWN_KNOBS = {
     # control flow), bench regression gate opt-in
     "RACON_TPU_SERVE_SAMPLE_S": "0",
     "RACON_TPU_BENCH_GATE": "",
+    # flight recorder (r14, racon_tpu/obs/flight.py): off-switch,
+    # ring capacity in events, dump path (daemon defaults to
+    # $TMPDIR/racon-tpu-flight-<pid>.json; the one-shot CLI only
+    # dumps when this is set)
+    "RACON_TPU_FLIGHT": "1",
+    "RACON_TPU_FLIGHT_RING": "4096",
+    "RACON_TPU_FLIGHT_DUMP": "",
 }
 
 # host-capability probe reference wall (bench.py's budget scaling):

@@ -14,6 +14,17 @@ setting ``RACON_TPU_TRACE=PATH`` in the environment (library runs,
 tests).  The recorded buffer is written by :func:`write_trace` —
 recording never touches the filesystem on the hot path.
 
+Request-scoped additions (r14): every event recorded under an active
+job context (racon_tpu/obs/context.py) is auto-tagged with
+``{"job", "tenant", "trace_id"}`` in its ``args``, and the serve
+daemon turns on :meth:`Tracer.enable_job_capture` — a bounded
+per-job span index (an LRU of small deques, NOT the unbounded full
+buffer) that backs ``submit --trace`` and the ``inspect``
+subcommand without the daemon accumulating an ever-growing trace.
+Flow events (``ph: s/t/f``) tie a tenant's unit-submit span to the
+shared fused-dispatch device span so Perfetto answers "whose work
+rode this megabatch" (racon_tpu/tpu/executor.py).
+
 Determinism: timestamps feed only the emitted JSON, never control
 flow.
 """
@@ -25,6 +36,7 @@ import os
 import sys
 import threading
 import time
+from collections import OrderedDict, deque
 from contextlib import contextmanager, nullcontext
 
 #: the one sanctioned monotonic clock for racon_tpu timing (see the
@@ -38,10 +50,27 @@ def _us(t: float) -> float:
     return (t - _EPOCH) * 1e6
 
 
+# context.py is stdlib-only, so this import cannot cycle back here
+from racon_tpu.obs.context import tag_args as _tag_args  # noqa: E402
+
+
+def epoch_offset(t: float) -> float:
+    """Seconds since the trace epoch — the shared timebase for trace
+    ``ts`` values and flight-recorder event timestamps, so ``inspect``
+    can interleave the two without clock reconciliation."""
+    return t - _EPOCH
+
+
 class Tracer:
     # virtual lanes get tids above this floor so they sort after the
     # real threads in the Perfetto track list
     _LANE_TID0 = 1 << 20
+
+    # bounded per-job index: spans kept per job, jobs kept total
+    # (oldest job evicted) — sized so a daemon serving thousands of
+    # jobs holds a constant-size trace memory
+    _JOB_SPANS = 2048
+    _JOB_MAX = 64
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -51,6 +80,8 @@ class Tracer:
         self._pid = os.getpid()
         self._tids: dict = {}        # thread ident -> small tid
         self._lanes: dict = {}       # lane name -> virtual tid
+        self._job_capture = False
+        self._by_job: OrderedDict = OrderedDict()  # job -> deque(ev)
 
     # -- gating --------------------------------------------------------
 
@@ -58,9 +89,21 @@ class Tracer:
     def enabled(self) -> bool:
         return self._enabled or bool(os.environ.get("RACON_TPU_TRACE"))
 
+    @property
+    def capturing(self) -> bool:
+        """True when events should be recorded at all: a trace output
+        is configured OR the per-job index is on (serve daemon)."""
+        return self._job_capture or self.enabled
+
     def enable(self, path: str) -> None:
         self._enabled = True
         self._path = path
+
+    def enable_job_capture(self) -> None:
+        """Keep a bounded per-job slice of every tagged event even
+        with no trace output path configured — the serve daemon's
+        ``submit --trace`` / ``inspect`` source."""
+        self._job_capture = True
 
     def out_path(self):
         return self._path or os.environ.get("RACON_TPU_TRACE") or None
@@ -73,10 +116,14 @@ class Tracer:
             tid = self._tids.get(ident)
             if tid is None:
                 tid = self._tids[ident] = len(self._tids) + 1
-                self._events.append({
-                    "name": "thread_name", "ph": "M", "pid": self._pid,
-                    "tid": tid,
-                    "args": {"name": threading.current_thread().name}})
+                # name metadata only matters to the full-trace file;
+                # job-capture-only mode must not grow _events at all
+                if self._enabled or os.environ.get("RACON_TPU_TRACE"):
+                    self._events.append({
+                        "name": "thread_name", "ph": "M",
+                        "pid": self._pid, "tid": tid,
+                        "args": {"name":
+                                 threading.current_thread().name}})
         return tid
 
     def _lane_tid(self, lane: str) -> int:
@@ -85,18 +132,52 @@ class Tracer:
             if tid is None:
                 tid = self._lanes[lane] = \
                     self._LANE_TID0 + len(self._lanes)
-                self._events.append({
-                    "name": "thread_name", "ph": "M", "pid": self._pid,
-                    "tid": tid, "args": {"name": lane}})
+                if self._enabled or os.environ.get("RACON_TPU_TRACE"):
+                    self._events.append({
+                        "name": "thread_name", "ph": "M",
+                        "pid": self._pid, "tid": tid,
+                        "args": {"name": lane}})
         return tid
+
+    @staticmethod
+    def _jobs_of(args, jobs):
+        """Job ids an event should be indexed under: an explicit
+        ``jobs`` list wins (fused dispatches span several jobs), else
+        the context-tagged ``args["job"]``."""
+        if jobs:
+            return [int(j) for j in jobs]
+        if args and "job" in args:
+            return [int(args["job"])]
+        return None
+
+    def _store(self, ev, jobs) -> None:
+        """Append to the full buffer (tracing on) and/or the bounded
+        per-job index (job capture on).  O(1); never grows the full
+        buffer when only the daemon's job capture is active."""
+        with self._lock:
+            if self._enabled or os.environ.get("RACON_TPU_TRACE"):
+                self._events.append(ev)
+            if self._job_capture and jobs:
+                for j in jobs:
+                    dq = self._by_job.get(j)
+                    if dq is None:
+                        dq = self._by_job[j] = \
+                            deque(maxlen=self._JOB_SPANS)
+                        while len(self._by_job) > self._JOB_MAX:
+                            self._by_job.popitem(last=False)
+                    dq.append(ev)
 
     def add_span(self, name: str, t0: float, t1: float,
                  cat: str = "host", lane: str = None,
-                 args: dict = None) -> None:
+                 args: dict = None, jobs: list = None) -> None:
         """Record an already-measured [t0, t1] interval (monotonic
         seconds) — the watcher-thread path, and the retroactive path
         for loops that already keep their own marks."""
-        if not self.enabled:
+        if not self.capturing:
+            return
+        args = _tag_args(args)
+        jobs = self._jobs_of(args, jobs)
+        if not self.enabled and not jobs:
             return
         tid = self._lane_tid(lane) if lane else self._tid()
         ev = {"name": name, "ph": "X", "cat": cat, "pid": self._pid,
@@ -104,19 +185,56 @@ class Tracer:
               "dur": max(0.0, (t1 - t0) * 1e6)}
         if args:
             ev["args"] = args
-        with self._lock:
-            self._events.append(ev)
+        self._store(ev, jobs)
 
     def add_instant(self, name: str, cat: str = "host",
-                    args: dict = None) -> None:
-        if not self.enabled:
+                    args: dict = None, jobs: list = None) -> None:
+        if not self.capturing:
+            return
+        args = _tag_args(args)
+        jobs = self._jobs_of(args, jobs)
+        if not self.enabled and not jobs:
             return
         ev = {"name": name, "ph": "i", "s": "t", "cat": cat,
               "pid": self._pid, "tid": self._tid(), "ts": _us(now())}
         if args:
             ev["args"] = args
+        self._store(ev, jobs)
+
+    def add_flow(self, name: str, flow_id: int, phase: str,
+                 cat: str = "fuse", lane: str = None, t: float = None,
+                 args: dict = None, jobs: list = None) -> None:
+        """Chrome flow event: ``phase`` is "s" (start), "t" (step) or
+        "f" (finish); same ``flow_id`` links the arrows.  Used by the
+        device executor to tie a tenant's unit-submit span to the
+        shared fused-dispatch span ("whose work rode this
+        megabatch").  ``bp: "e"`` binds the finish to the enclosing
+        span rather than the next one, which is what makes the arrow
+        land on the dispatch span itself."""
+        if not self.capturing:
+            return
+        args = _tag_args(args)
+        jobs = self._jobs_of(args, jobs)
+        if not self.enabled and not jobs:
+            return
+        tid = self._lane_tid(lane) if lane else self._tid()
+        ev = {"name": name, "ph": phase, "cat": cat, "pid": self._pid,
+              "tid": tid, "id": int(flow_id),
+              "ts": _us(t if t is not None else now())}
+        if phase == "f":
+            ev["bp"] = "e"
+        if args:
+            ev["args"] = args
+        self._store(ev, jobs)
+
+    def job_slice(self, job_id) -> list:
+        """The bounded per-job event list for ``job_id`` (ts-sorted
+        copies) — empty when unknown or evicted."""
         with self._lock:
-            self._events.append(ev)
+            dq = self._by_job.get(int(job_id))
+            evs = [dict(ev) for ev in dq] if dq else []
+        evs.sort(key=lambda ev: ev.get("ts", 0.0))
+        return evs
 
     # -- output --------------------------------------------------------
 
@@ -145,6 +263,7 @@ class Tracer:
             self._events.clear()
             self._tids.clear()
             self._lanes.clear()
+            self._by_job.clear()
 
 
 TRACER = Tracer()
@@ -167,7 +286,7 @@ def span(name: str, cat: str = "host", args: dict = None,
     """Trace span around a block; with ``metric`` the elapsed seconds
     also accumulate into ``registry`` (default: the global registry),
     whether or not tracing is enabled."""
-    timed = metric is not None or TRACER.enabled
+    timed = metric is not None or TRACER.capturing
     t0 = now() if timed else 0.0
     try:
         yield

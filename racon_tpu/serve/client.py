@@ -60,12 +60,15 @@ def request(socket_path: str, frame: dict, timeout: float = None):
 
 
 def submit(socket_path: str, spec: dict, priority: int = 0,
-           timeout: float = None) -> dict:
+           timeout: float = None, want_trace: bool = False) -> dict:
     """Submit one job and block until it completes (or is rejected).
-    Returns the raw response frame; callers check ``resp["ok"]``."""
-    return request(socket_path,
-                   {"op": "submit", "job": spec,
-                    "priority": priority}, timeout=timeout)
+    Returns the raw response frame; callers check ``resp["ok"]``.
+    ``want_trace`` asks the server to attach the job's trace slice
+    (``trace_events``) and flight events (``flight_events``)."""
+    frame = {"op": "submit", "job": spec, "priority": priority}
+    if want_trace:
+        frame["trace"] = True
+    return request(socket_path, frame, timeout=timeout)
 
 
 def status(socket_path: str, timeout: float = 30.0) -> dict:
@@ -85,6 +88,19 @@ def metrics(socket_path: str, timeout: float = 30.0) -> dict:
 def health(socket_path: str, timeout: float = 30.0) -> dict:
     """Cheap liveness/readiness document."""
     return request(socket_path, {"op": "health"}, timeout=timeout)
+
+
+def flight(socket_path: str, job=None, last: int = 0,
+           timeout: float = 30.0) -> dict:
+    """Live flight-recorder view: ring stats + events, optionally
+    filtered to one ``job`` (adds its trace slice as ``job_trace``)
+    or the newest ``last`` events."""
+    frame = {"op": "flight"}
+    if job is not None:
+        frame["job"] = int(job)
+    if last:
+        frame["last"] = int(last)
+    return request(socket_path, frame, timeout=timeout)
 
 
 def watch(socket_path: str, interval_s: float = 1.0, count: int = 0,
@@ -189,7 +205,8 @@ def main_submit(argv) -> int:
     try:
         resp = submit(socket_path,
                       spec_from_opts(opts, inputs, tenant=tenant),
-                      priority=priority)
+                      priority=priority,
+                      want_trace=bool(opts["trace"]))
     except ServeError as exc:
         print(f"[racon_tpu::submit] error: {exc}", file=sys.stderr)
         return 1
@@ -213,6 +230,27 @@ def main_submit(argv) -> int:
         os.replace(tmp, opts["metrics_json"])
         print(f"[racon_tpu::submit] metrics report written to "
               f"{opts['metrics_json']}", file=sys.stderr)
+    if opts["trace"]:
+        # the job's server-side trace slice as a loadable Chrome
+        # trace doc; the flight events ride along under a key
+        # Perfetto ignores but `racon-tpu inspect` reads
+        events = resp.get("trace_events") or []
+        pid = events[0].get("pid", 0) if events else 0
+        doc = {
+            "traceEvents": [{"name": "process_name", "ph": "M",
+                             "pid": pid, "tid": 0,
+                             "args": {"name": "racon-tpu serve"}}]
+            + events,
+            "displayTimeUnit": "ms",
+            "flightEvents": resp.get("flight_events") or [],
+        }
+        tmp = opts["trace"] + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, opts["trace"])
+        print(f"[racon_tpu::submit] job trace written to "
+              f"{opts['trace']} ({len(events)} event(s))",
+              file=sys.stderr)
     print(f"[racon_tpu::submit] job {resp['job_id']} done in "
           f"{resp['wall_s']:.2f} s "
           f"({resp['n_sequences']} sequence(s))", file=sys.stderr)
@@ -244,4 +282,20 @@ def main_status(argv) -> int:
     print(f"queue       {q.get('queue_depth')}/{q.get('max_queue')} "
           f"queued, {len(q.get('running', []))}/{q.get('max_jobs')} "
           f"running, {q.get('completed')} completed")
+    tenants = q.get("tenants") or {}
+    if tenants:
+        from racon_tpu.obs import export
+        hists = (doc.get("registry") or {}).get("histograms", {})
+        print("tenant      queued  running  wait p50/p90/p99")
+        for name in sorted(tenants):
+            row = tenants[name]
+            h = hists.get(f"serve_tenant_wait_s.{name}")
+            if h and h.get("count"):
+                p = export.percentiles(h)
+                waits = (f"{p['p50'] * 1e3:.0f}/{p['p90'] * 1e3:.0f}/"
+                         f"{p['p99'] * 1e3:.0f} ms")
+            else:
+                waits = "-"
+            print(f"{name:<11s} {row.get('queued', 0):>6d}  "
+                  f"{row.get('running', 0):>7d}  {waits}")
     return 0

@@ -8,8 +8,8 @@ Every message in both directions is one frame::
 
 The JSON document is always an object.  Client requests carry an
 ``op`` key (``submit`` / ``status`` / ``pause`` / ``resume`` /
-``shutdown`` / ``metrics`` / ``health`` / ``watch``); server
-responses carry ``ok`` (bool) and, when ``ok`` is false, a
+``shutdown`` / ``metrics`` / ``health`` / ``watch`` / ``flight``);
+server responses carry ``ok`` (bool) and, when ``ok`` is false, a
 machine-readable ``error`` object::
 
     {"ok": false,
@@ -51,6 +51,18 @@ Telemetry ops (r12, racon_tpu/obs/export.py):
   1.0), ``seq``-numbered, until the optional ``count`` is reached,
   the client closes, or the server drains.  Every frame carries
   ``ok: true``; the stream ending is the only termination signal.
+
+Forensics ops (r14, racon_tpu/obs/flight.py):
+
+* ``flight`` — the live flight-recorder view: ring stats (``ring``)
+  and the structured event list (``events``), optionally filtered
+  with ``job: <id>`` (that job's events only, plus its bounded trace
+  slice as ``job_trace``) and/or ``last: <n>`` (newest n events).
+* ``submit`` with ``trace: true`` — the response frame additionally
+  carries the finished job's trace slice (``trace_events``, Chrome
+  trace events tagged ``{job, tenant, trace_id}``) and its flight
+  events (``flight_events``) — the ``racon-tpu inspect`` /
+  ``submit --trace`` source.
 """
 
 from __future__ import annotations

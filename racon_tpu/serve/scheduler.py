@@ -46,6 +46,8 @@ import threading
 from typing import Callable, Optional
 
 from racon_tpu.obs import REGISTRY
+from racon_tpu.obs import context as obs_context
+from racon_tpu.obs import flight as obs_flight
 from racon_tpu.obs import trace as obs_trace
 
 
@@ -163,6 +165,19 @@ class JobScheduler:
         """Admit a job or raise :class:`RejectError`.  Never blocks on
         queue capacity — backpressure is an immediate structured
         reject, so a full server answers in microseconds."""
+        try:
+            return self._submit(spec, priority)
+        except RejectError as exc:
+            obs_flight.FLIGHT.record(
+                "reject",
+                tenant=(spec.get("tenant")
+                        if isinstance(spec, dict) else None),
+                code=exc.error.get("code"),
+                predicted_wall_s=(exc.error.get("estimate") or {})
+                .get("predicted_wall_s"))
+            raise
+
+    def _submit(self, spec: dict, priority: int) -> Job:
         for key in ("sequences", "overlaps", "targets"):
             path = spec.get(key)
             if not isinstance(path, str):
@@ -223,8 +238,18 @@ class JobScheduler:
             REGISTRY.set("serve_queue_depth", len(self._heap))
             obs_trace.TRACER.add_instant(
                 "serve.submit", cat="serve",
-                args={"job": job.id, "priority": priority,
+                args={"job": job.id, "tenant": tenant,
+                      "priority": priority,
                       "queue_depth": len(self._heap)})
+            obs_flight.FLIGHT.record(
+                "admit", job=job.id, tenant=tenant,
+                priority=priority,
+                predicted_wall_s=round(
+                    estimate.get("predicted_wall_s", 0.0), 4),
+                shared_wall_s=(round(estimate["shared_wall_s"], 4)
+                               if "shared_wall_s" in estimate
+                               else None),
+                queue_depth=len(self._heap))
             self._cond.notify()
             return job
 
@@ -251,12 +276,16 @@ class JobScheduler:
             # (pop -> finish), e2e wall (admission -> finish).
             # Observability only -- nothing downstream reads them.
             t_pop = obs_trace.now()
+            queue_wait = None
             if job.t_submit is not None:
-                REGISTRY.observe("serve_queue_wait_s",
-                                 t_pop - job.t_submit)
+                queue_wait = t_pop - job.t_submit
+                REGISTRY.observe("serve_queue_wait_s", queue_wait)
                 REGISTRY.observe(
-                    f"serve_queue_wait_s.{job.tenant}",
-                    t_pop - job.t_submit)
+                    f"serve_queue_wait_s.{job.tenant}", queue_wait)
+            obs_flight.FLIGHT.record(
+                "start", job=job.id, tenant=job.tenant,
+                queue_wait_s=(round(queue_wait, 6)
+                              if queue_wait is not None else None))
             # the job is a device-executor tenant for its lifetime:
             # its megabatches fuse with other registered tenants',
             # under the executor's DRR fairness + in-flight quota
@@ -265,18 +294,32 @@ class JobScheduler:
             ex = device_executor.get_executor()
             ex.register_tenant(job.tenant,
                                weight=max(1.0, 1.0 + job.priority))
-            try:
-                result = self._runner(job)
-            except Exception as exc:   # runner bug: job fails, server
-                result = {              # and queue survive
-                    "ok": False,
-                    "error": {"code": "job_failed",
-                              "type": type(exc).__name__,
-                              "reason": str(exc)}}
-            finally:
-                ex.release_tenant(job.tenant)
+            # the job context makes everything recorded during this
+            # job's execution — spans, flight events, log lines —
+            # attributable to (job, tenant) with no call-site plumbing
+            with obs_context.job_context(job.id, job.tenant):
+                try:
+                    result = self._runner(job)
+                except Exception as exc:  # runner bug: job fails,
+                    obs_flight.FLIGHT.record_exception(  # server and
+                        "error", exc)                    # queue survive
+                    result = {
+                        "ok": False,
+                        "error": {"code": "job_failed",
+                                  "type": type(exc).__name__,
+                                  "reason": str(exc)}}
+                finally:
+                    ex.release_tenant(job.tenant)
             t_done = obs_trace.now()
             exec_wall = t_done - t_pop
+            obs_trace.TRACER.add_span(
+                "serve.exec", t_pop, t_done, cat="serve",
+                args={"job": job.id, "tenant": job.tenant,
+                      "ok": bool(result.get("ok"))})
+            obs_flight.FLIGHT.record(
+                "done", job=job.id, tenant=job.tenant,
+                ok=bool(result.get("ok")),
+                exec_wall_s=round(exec_wall, 6))
             REGISTRY.observe("serve_exec_wall_s", exec_wall)
             if job.t_submit is not None:
                 REGISTRY.observe("serve_e2e_wall_s",
@@ -312,10 +355,19 @@ class JobScheduler:
         """Flip to draining: new submissions reject, queued + running
         jobs keep going.  A paused queue resumes — admitted jobs were
         promised execution."""
+        first = False
         with self._cond:
+            if not self._draining:
+                first = True
+                queued, running = len(self._heap), len(self._running)
             self._draining = True
             self._paused = False
             self._cond.notify_all()
+        if first:
+            # the forensic drain marker: a post-SIGTERM flight dump
+            # shows when admission closed and what was still in flight
+            obs_flight.FLIGHT.record("drain", queued=queued,
+                                     running=running)
 
     def wait_drained(self, timeout: float = None) -> bool:
         """Block until every admitted job finished, then stop the
@@ -343,6 +395,15 @@ class JobScheduler:
 
     def snapshot(self) -> dict:
         with self._cond:
+            tenants: dict = {}
+            for _, _, job in self._heap:
+                row = tenants.setdefault(
+                    job.tenant, {"queued": 0, "running": 0})
+                row["queued"] += 1
+            for job in self._running.values():
+                row = tenants.setdefault(
+                    job.tenant, {"queued": 0, "running": 0})
+                row["running"] += 1
             return {
                 "queue_depth": len(self._heap),
                 "max_queue": self.max_queue,
@@ -351,4 +412,5 @@ class JobScheduler:
                 "completed": self._completed,
                 "paused": self._paused,
                 "draining": self._draining,
+                "tenants": {t: tenants[t] for t in sorted(tenants)},
             }

@@ -15,8 +15,11 @@
 * accepts length-prefixed JSON frames (racon_tpu/serve/protocol.py)
   on the socket — one request per connection for ``submit`` (the
   connection blocks until the job finishes; that is the client's
-  rendezvous), ``status`` / ``pause`` / ``resume`` / ``shutdown`` /
-  ``metrics`` / ``health`` answer immediately, and ``watch`` streams
+  rendezvous — with ``trace: true`` the response also carries the
+  job's trace slice + flight events), ``status`` / ``pause`` /
+  ``resume`` / ``shutdown`` / ``metrics`` / ``health`` /
+  ``flight`` (live flight-recorder ring, optionally filtered to one
+  job) answer immediately, and ``watch`` streams
   periodic telemetry frames on its connection until the client
   closes or the server drains (racon-tpu top's feed);
 * optionally runs a background telemetry sampler
@@ -48,6 +51,7 @@ import sys
 import threading
 
 from racon_tpu.obs import REGISTRY
+from racon_tpu.obs import flight as obs_flight
 from racon_tpu.obs import trace as obs_trace
 from racon_tpu.serve import protocol
 from racon_tpu.serve.scheduler import JobScheduler, RejectError
@@ -73,6 +77,12 @@ class PolishServer:
         self._t_start = obs_trace.now()
         self._last_activity = self._t_start
         self._lock = threading.Lock()
+        self._exit_reason = "drain"
+        # request-scoped forensics (r14): keep a bounded per-job
+        # trace slice for `submit --trace` / `inspect`, and dump the
+        # flight ring if any thread dies with an unhandled exception
+        obs_trace.TRACER.enable_job_capture()
+        obs_flight.FLIGHT.install_dump_on_crash()
 
     # -- warm state ----------------------------------------------------
 
@@ -105,7 +115,17 @@ class PolishServer:
             return {"ok": False, "error": exc.error}
         job.done.wait()
         self._touch()
-        return job.result
+        if not req.get("trace"):
+            return job.result
+        # job-scoped observability rides the response frame: the
+        # trace slice (spans + flow events tagged with this job) and
+        # the flight events, so the client can render/inspect the
+        # job without any follow-up op
+        result = dict(job.result or {})
+        result["trace_events"] = obs_trace.TRACER.job_slice(job.id)
+        result["flight_events"] = obs_flight.FLIGHT.snapshot(
+            job=job.id)
+        return result
 
     def _status_doc(self) -> dict:
         from racon_tpu.obs import provenance
@@ -152,6 +172,28 @@ class PolishServer:
         }
         if prometheus:
             doc["prometheus"] = export.prometheus_text(snap)
+        return doc
+
+    def _flight_doc(self, req: dict) -> dict:
+        """The live flight-recorder view (``flight`` op): ring stats
+        plus events — optionally filtered to one job (``job``) or the
+        newest N (``last``); with ``job`` the bounded per-job trace
+        slice rides along for timeline rendering."""
+        try:
+            job = req.get("job")
+            job = int(job) if job is not None else None
+            last = int(req.get("last", 0) or 0)
+        except (TypeError, ValueError):
+            return protocol.error_frame(
+                "bad_request", "flight: job/last must be integers")
+        doc = {
+            "ok": True,
+            "pid": os.getpid(),
+            "ring": obs_flight.FLIGHT.stats(),
+            "events": obs_flight.FLIGHT.snapshot(job=job, last=last),
+        }
+        if job is not None:
+            doc["job_trace"] = obs_trace.TRACER.job_slice(job)
         return doc
 
     def _health_doc(self) -> dict:
@@ -233,6 +275,8 @@ class PolishServer:
                 resp = self.telemetry_doc(prometheus=True)
             elif op == "health":
                 resp = self._health_doc()
+            elif op == "flight":
+                resp = self._flight_doc(req)
             elif op == "pause":
                 self.scheduler.pause()
                 resp = {"ok": True, "paused": True}
@@ -331,6 +375,7 @@ class PolishServer:
                 elif self._idle_expired():
                     eprint("[racon_tpu::serve] idle timeout reached, "
                            "shutting down")
+                    self._exit_reason = "idle_timeout"
                     break
                 try:
                     conn, _ = self._sock.accept()
@@ -365,6 +410,17 @@ class PolishServer:
             except OSError:
                 pass
         snap = self.scheduler.snapshot()
+        if obs_flight.enabled():
+            # the ring now holds the drain marker and every job's
+            # final events — persist it so a post-mortem has the
+            # same record the live `flight` op would have served
+            try:
+                path = obs_flight.FLIGHT.dump(
+                    reason=self._exit_reason)
+                eprint(f"[racon_tpu::serve] flight dump: {path}")
+            except OSError as exc:
+                eprint(f"[racon_tpu::serve] flight dump failed: "
+                       f"{exc}")
         eprint(f"[racon_tpu::serve] drained "
                f"({snap['completed']} job(s) served); bye")
 

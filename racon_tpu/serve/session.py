@@ -34,6 +34,7 @@ import base64
 
 from racon_tpu import obs
 from racon_tpu.obs import REGISTRY
+from racon_tpu.obs import flight as obs_flight
 from racon_tpu.obs import trace as obs_trace
 
 #: global counters the session re-reports per job as deltas
@@ -101,6 +102,10 @@ def run_job(job) -> dict:
         obs_trace.TRACER.add_instant(
             "serve.job_failed", cat="serve",
             args={"job": job.id, "type": type(exc).__name__})
+        # the traceback goes to the flight ring (bounded), not the
+        # response frame — a post-mortem reads it from the dump or
+        # the `flight` op
+        obs_flight.FLIGHT.record_exception("error", exc, job=job.id)
         return {"ok": False,
                 "error": {"code": "job_failed",
                           "type": type(exc).__name__,

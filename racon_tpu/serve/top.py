@@ -51,6 +51,28 @@ def render(doc: dict) -> str:
         f"queued  {len(q.get('running', []))}/{q.get('max_jobs', '?')} "
         f"running  {q.get('completed', 0)} done")
 
+    # per-tenant breakdown: scheduler occupancy (queued/running) plus
+    # the executor-side fused-queue wait percentiles the r13 SLO
+    # histograms record per tenant
+    tenants = q.get("tenants") or {}
+    slo = doc.get("slo") or {}
+    if tenants:
+        lines.append("")
+        lines.append("tenant       queued  running  wait p50    "
+                     "p90       p99")
+        for name in sorted(tenants):
+            row = tenants[name]
+            s = slo.get(f"serve_tenant_wait_s.{name}") or {}
+            if s.get("count"):
+                waits = (f"{_fmt_s(s['p50']):<8s}  "
+                         f"{_fmt_s(s['p90']):<8s}  "
+                         f"{_fmt_s(s['p99']):<8s}")
+            else:
+                waits = "-"
+            lines.append(
+                f"{name:<12s} {row.get('queued', 0):>6d}  "
+                f"{row.get('running', 0):>7d}  {waits}")
+
     du = doc.get("device_util") or {}
     if du:
         lines.append("")

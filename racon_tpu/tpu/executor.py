@@ -89,14 +89,22 @@ its ``metrics``/``top`` telemetry.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
 from collections import OrderedDict
 
 from racon_tpu.obs import REGISTRY
+from racon_tpu.obs import context as obs_context
+from racon_tpu.obs import flight as obs_flight
+from racon_tpu.obs.trace import TRACER
 
 _mono = time.monotonic
+
+#: flow-event ids linking a unit's submit instant to the fused
+#: dispatch span it rode (Chrome trace ``id`` field)
+_FLOW_IDS = itertools.count(1)
 
 
 def _env_int(name: str, default: int) -> int:
@@ -150,7 +158,7 @@ class _Unit:
 
     __slots__ = ("kind", "tenant", "payload", "size", "cap", "pool",
                  "t_submit", "done", "fused", "lo", "hi", "retry",
-                 "fuse_dispatch")
+                 "fuse_dispatch", "flow_id", "jobs")
 
     def __init__(self, kind, tenant, payload, size, cap, pool):
         self.kind = kind            # "poa" | "wfa" | "band"
@@ -164,6 +172,8 @@ class _Unit:
         self.fused = None           # _FusedDispatch once dispatched
         self.lo = self.hi = 0       # slice of the fused batch
         self.retry = None           # per-unit fallback dispatch fn
+        self.flow_id = 0            # trace flow-event id
+        self.jobs = ()              # serve job ids this unit belongs to
 
 
 class _FusedDispatch:
@@ -356,6 +366,28 @@ class DeviceExecutor:
         return PoaEngineHandle(self, engine, tenant, cap)
 
     # -- submissions ---------------------------------------------------------
+    def _tag_unit(self, unit: _Unit) -> None:
+        """Attribute the unit to its serve job(s) — the submitting
+        thread's job context when present, else every job currently
+        running under the unit's tenant (polisher pool threads carry
+        no contextvar) — and emit the flow-start event that Perfetto
+        ties to the fused dispatch this unit ends up riding.
+        Observability only; no-op overhead outside the daemon."""
+        ctx = obs_context.current()
+        if ctx is not None:
+            unit.jobs = (ctx.job_id,)
+        else:
+            unit.jobs = tuple(obs_context.jobs_for_tenant(unit.tenant))
+        unit.flow_id = next(_FLOW_IDS)
+        if TRACER.capturing:
+            jobs = list(unit.jobs)
+            TRACER.add_instant(
+                f"executor.submit.{unit.kind}", cat="fuse",
+                args={"tenant": unit.tenant, "size": unit.size,
+                      "flow": unit.flow_id}, jobs=jobs)
+            TRACER.add_flow(f"executor.unit.{unit.kind}",
+                            unit.flow_id, "s", jobs=jobs)
+
     def submit_poa(self, handle: PoaEngineHandle, windows, trim,
                    pool=None):
         """Returns a zero-arg collect closure, like the engine's."""
@@ -366,6 +398,7 @@ class DeviceExecutor:
         key = ("poa", id(engine), bool(trim))
         unit = _Unit("poa", handle.tenant, list(windows),
                      len(windows), handle.cap, pool)
+        self._tag_unit(unit)
         unit.retry = lambda u: engine.consensus_batch_async(
             u.payload, trim, pool=u.pool or self._pool())
         self._enqueue(key, unit, lambda units, pool: (
@@ -390,6 +423,7 @@ class DeviceExecutor:
         key = ("wfa", lq, emax, _mesh_key(mesh))
         unit = _Unit("wfa", tenant, (list(queries), list(targets)),
                      len(queries), 0, None)
+        self._tag_unit(unit)
         unit.retry = lambda u: align_pallas.wfa_dispatch(
             u.payload[0], u.payload[1], lq, emax, mesh=mesh)
         self._enqueue(key, unit, lambda units, pool: (
@@ -414,6 +448,7 @@ class DeviceExecutor:
         unit = _Unit("band", tenant,
                      (list(queries), list(targets), cent),
                      len(queries), 0, None)
+        self._tag_unit(unit)
         unit.retry = lambda u: align_pallas.align_dispatch(
             u.payload[0], u.payload[1], lq, lt, wb, mesh=mesh,
             centers=u.payload[2])
@@ -601,14 +636,37 @@ class DeviceExecutor:
             REGISTRY.add("fused_megabatches")
             if len(tenants) > 1:
                 REGISTRY.add("fused_cross_tenant")
-        REGISTRY.observe("fusion_occupancy",
-                         total / target if target else 1.0)
+        occupancy = total / target if target else 1.0
+        REGISTRY.observe("fusion_occupancy", occupancy)
         try:
             collect, n_items = units[0].fuse_dispatch(
                 units, self._pool())
             fused = _FusedDispatch(collect, n_items, units)
         except BaseException as exc:  # containment: fall back per unit
             fused = _FusedDispatch(_raiser(exc), total, units)
+        # attribution: the shared dispatch span + per-unit flow
+        # finishes land on the "executor" lane, tagged with every job
+        # whose work rode this megabatch; the flight recorder keeps
+        # the same summary for post-mortem inspection
+        jobs = sorted({j for u in units for j in u.jobs})
+        t1 = _mono()
+        if TRACER.capturing:
+            TRACER.add_span(
+                "executor.fused_dispatch", now, t1, cat="fuse",
+                lane="executor",
+                args={"kind": units[0].kind, "units": len(units),
+                      "items": total, "occupancy": round(occupancy, 4),
+                      "tenants": sorted(tenants), "jobs": jobs},
+                jobs=jobs)
+            for u in units:
+                TRACER.add_flow(f"executor.unit.{u.kind}", u.flow_id,
+                                "f", lane="executor", t=t1,
+                                jobs=list(u.jobs))
+        obs_flight.FLIGHT.record(
+            "fused_dispatch", unit_kind=units[0].kind,
+            units=len(units), items=total,
+            occupancy=round(occupancy, 4), tenants=sorted(tenants),
+            jobs=jobs or None)
         # in-flight decrements on completion of the shared device
         # work: piggyback on the first collect (wrapped BEFORE units
         # wake so no collect can slip past the accounting)

@@ -16,6 +16,12 @@ stderr format byte-identical:
   registry, so a Perfetto trace carries the same stage markers the
   reference gets from its stderr log.
 
+One r14 addition: lines emitted under an active job context
+(racon_tpu/obs/context.py — i.e. inside a serve worker) get a
+``[job 17/tenantA]`` prefix so concurrent jobs' interleaved stderr
+is attributable.  The format stays byte-identical when no context is
+active (one-shot CLI, library use, tests).
+
 Device-stage trace spans live at the dispatch sites
 (racon_tpu/tpu/polisher.py via racon_tpu.obs.device_span), the analog
 of the reference's nvprof ranges (src/cuda/cudapolisher.cpp:66-70).
@@ -26,6 +32,20 @@ from __future__ import annotations
 import sys
 import threading
 import time
+
+
+def _ctx_prefix() -> str:
+    """``"[job 17/tenantA] "`` under an active job context, else
+    ``""`` — never raises (logging must never take the polish
+    down)."""
+    try:
+        from racon_tpu.obs import context as obs_context
+        ctx = obs_context.current()
+    except Exception:
+        return ""
+    if ctx is None:
+        return ""
+    return f"[job {ctx.job_id}/{ctx.tenant}] "
 
 
 class Logger:
@@ -50,7 +70,8 @@ class Logger:
                 return
             elapsed = now - self._start
             self._time += elapsed
-            print(f"{message} {elapsed:.6f} s", file=sys.stderr)
+            print(f"{_ctx_prefix()}{message} {elapsed:.6f} s",
+                  file=sys.stderr)
             self._start = now
         self._trace(message)
 
@@ -69,7 +90,8 @@ class Logger:
                 tty = False
             if tty or self._bar_state == 20:
                 lead = "\r" if tty else ""
-                print(f"{lead}{message} [{bar}] {percent}%", end=end,
+                print(f"{lead}{_ctx_prefix()}{message} [{bar}] "
+                      f"{percent}%", end=end,
                       file=sys.stderr, flush=True)
             if self._bar_state == 20:
                 now = time.monotonic()
@@ -81,7 +103,8 @@ class Logger:
         with self._lock:
             self._time += time.monotonic() - self._start
             total = self._time
-            print(f"{message} {total:.6f} s", file=sys.stderr)
+            print(f"{_ctx_prefix()}{message} {total:.6f} s",
+                  file=sys.stderr)
         try:
             from racon_tpu.obs.metrics import REGISTRY
             REGISTRY.set("logger_total_s", round(total, 6))

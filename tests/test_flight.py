@@ -1,0 +1,641 @@
+"""Per-job tracing + always-on flight recorder (ISSUE 10 / r14).
+
+Three layers, matching the forensics story:
+
+* **unit** — ring bounds (size/seq/dropped), off-switch, bounded
+  tracebacks, dump/load roundtrip, the job-context contextvar +
+  tenant registry, context auto-tagging of trace events and flight
+  events, the logger's ``[job N/tenant]`` prefix, and the pure
+  ``inspect`` renderers;
+* **scheduler** — an in-process JobScheduler with a stub runner
+  leaves admit/start/done (and reject) flight events with the SLO
+  fields (queue_wait_s, exec_wall_s, predicted_wall_s), and its
+  snapshot carries the per-tenant queued/running rows;
+* **end-to-end** — the one-shot CLI's flight dump lands BEFORE the
+  ``os._exit`` hard exit and a flight-on + traced run emits bytes
+  identical to the obs-off run; a live daemon (fusion forced)
+  answers ``submit --trace`` with a non-empty per-job trace slice,
+  serves the ``flight`` op, renders a job timeline through
+  ``racon-tpu inspect --socket`` (queue wait, exec, a fused dispatch
+  with occupancy), and after SIGTERM mid-job leaves a dump that
+  ``inspect --dump`` reads — admit/exec events plus the drain
+  marker.
+
+The daemon tests reuse tests/test_serve.py's conventions: pinned
+calibration rates for byte determinism, /tmp sockets (108-byte unix
+path cap), probe-connect startup.
+"""
+
+import base64
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from racon_tpu.obs import context as obs_context  # noqa: E402
+from racon_tpu.obs import flight as obs_flight  # noqa: E402
+from racon_tpu.obs import trace as obs_trace  # noqa: E402
+from racon_tpu.serve import client  # noqa: E402
+from racon_tpu.serve import inspect as serve_inspect  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# flight recorder unit
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_bounds_and_seq():
+    fr = obs_flight.FlightRecorder(maxlen=24)
+    for i in range(40):
+        fr.record("tick", i=i)
+    st = fr.stats()
+    assert st["size"] == 24
+    assert st["capacity"] == 24
+    assert st["recorded"] == 40
+    assert st["dropped"] == 16
+    evs = fr.snapshot()
+    # oldest first, monotone seq, the oldest 16 evicted
+    assert [ev["seq"] for ev in evs] == list(range(17, 41))
+    assert all(ev["kind"] == "tick" and ev["t"] >= 0 for ev in evs)
+    assert [ev["seq"] for ev in fr.snapshot(last=5)] == \
+        list(range(36, 41))
+
+
+def test_flight_off_switch(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_FLIGHT", "0")
+    fr = obs_flight.FlightRecorder(maxlen=24)
+    fr.record("tick")
+    st = fr.stats()
+    assert st["size"] == 0 and st["recorded"] == 0
+    assert st["enabled"] is False
+
+
+def test_flight_exception_event_bounded():
+    fr = obs_flight.FlightRecorder(maxlen=24)
+
+    def deep(n):
+        if n == 0:
+            raise ValueError("boom")
+        deep(n - 1)
+
+    try:
+        deep(400)   # traceback well past the 8000-byte cap
+    except ValueError as exc:
+        fr.record_exception("error", exc, job=3)
+    (ev,) = fr.snapshot()
+    assert ev["kind"] == "error" and ev["job"] == 3
+    assert ev["error"] == "ValueError: boom"
+    # the TAIL is kept: the raise site and the exception line survive
+    assert ev["traceback"].rstrip().endswith("ValueError: boom")
+    assert len(ev["traceback"]) <= 8000
+
+
+def test_flight_dump_load_roundtrip(tmp_path):
+    fr = obs_flight.FlightRecorder(maxlen=24)
+    fr.record("admit", job=1, tenant="tA", predicted_wall_s=2.5)
+    fr.record("done", job=1, tenant="tA", ok=True)
+    path = str(tmp_path / "flight.json")
+    assert fr.dump(path, reason="unit") == path
+    doc = obs_flight.load_dump(path)
+    assert doc["schema"] == obs_flight.SCHEMA
+    assert doc["reason"] == "unit" and doc["pid"] == os.getpid()
+    assert [ev["kind"] for ev in doc["events"]] == ["admit", "done"]
+    assert doc["ring"]["size"] == 2
+    # a non-flight JSON file is refused, not misparsed
+    bad = str(tmp_path / "other.json")
+    with open(bad, "w") as f:
+        json.dump({"schema": "something-else"}, f)
+    with pytest.raises(ValueError):
+        obs_flight.load_dump(bad)
+
+
+def test_flight_snapshot_job_filter():
+    fr = obs_flight.FlightRecorder(maxlen=24)
+    fr.record("admit", job=1)
+    fr.record("admit", job=2)
+    fr.record("fused_dispatch", jobs=[1, 2], occupancy=0.5)
+    fr.record("drain")
+    kinds = [ev["kind"] for ev in fr.snapshot(job=1)]
+    assert kinds == ["admit", "fused_dispatch"]
+    assert len(fr.snapshot(job=2)) == 2
+    assert len(fr.snapshot()) == 4
+
+
+# ---------------------------------------------------------------------------
+# job context: contextvar, tenant registry, auto-tagging
+# ---------------------------------------------------------------------------
+
+def test_job_context_registry_and_nesting():
+    assert obs_context.current() is None
+    assert obs_context.jobs_for_tenant("tA") == []
+    with obs_context.job_context(7, "tA") as ctx:
+        assert obs_context.current() == ctx
+        assert ctx.job_id == 7 and ctx.tenant == "tA"
+        assert re.fullmatch(r"[0-9a-f]{8}-\d{6}", ctx.trace_id)
+        assert obs_context.jobs_for_tenant("tA") == [7]
+        with obs_context.job_context(9, "tA"):
+            assert obs_context.current().job_id == 9
+            assert obs_context.jobs_for_tenant("tA") == [7, 9]
+        assert obs_context.current() == ctx
+    assert obs_context.current() is None
+    assert obs_context.jobs_for_tenant("tA") == []
+
+
+def test_context_does_not_cross_threads():
+    seen = {}
+    with obs_context.job_context(7, "tA"):
+        t = threading.Thread(target=lambda: seen.update(
+            ctx=obs_context.current(),
+            reg=obs_context.jobs_for_tenant("tA")))
+        t.start()
+        t.join()
+    # the contextvar stays on the entering thread; the tenant
+    # registry is the sanctioned cross-thread path
+    assert seen["ctx"] is None
+    assert seen["reg"] == [7]
+
+
+def test_context_tags_flight_and_trace_events():
+    fr = obs_flight.FlightRecorder(maxlen=24)
+    tr = obs_trace.Tracer()
+    tr.enable_job_capture()
+    with obs_context.job_context(17, "tenantA") as ctx:
+        fr.record("ping")
+        t0 = obs_trace.now()
+        tr.add_span("work", t0, t0 + 0.001, cat="t")
+        tr.add_instant("mark", cat="t")
+    (ev,) = fr.snapshot()
+    assert ev["job"] == 17 and ev["tenant"] == "tenantA"
+    evs = tr.job_slice(17)
+    assert [e["name"] for e in evs] == ["work", "mark"]
+    for e in evs:
+        assert e["args"]["job"] == 17
+        assert e["args"]["tenant"] == "tenantA"
+        assert e["args"]["trace_id"] == ctx.trace_id
+    # job capture alone must NOT grow the full trace buffer
+    assert tr.job_slice(99) == []
+    assert not tr._events, (
+        "job capture leaked events into the unbounded full buffer")
+
+
+def test_trace_flow_events_and_job_index_bound():
+    tr = obs_trace.Tracer()
+    tr.enable_job_capture()
+    tr.add_flow("executor.unit.poa", 5, "s", jobs=[4])
+    tr.add_flow("executor.unit.poa", 5, "f", lane="executor",
+                jobs=[4])
+    evs = tr.job_slice(4)
+    assert [e["ph"] for e in evs] == ["s", "f"]
+    assert all(e["id"] == 5 for e in evs)
+    assert evs[1]["bp"] == "e"
+    # the per-job index is bounded: spans per job...
+    for i in range(tr._JOB_SPANS + 10):
+        tr.add_instant("x", cat="t", jobs=[4])
+    assert len(tr.job_slice(4)) == tr._JOB_SPANS
+    # ...and jobs total (oldest evicted)
+    for j in range(100, 100 + tr._JOB_MAX):
+        tr.add_instant("x", cat="t", jobs=[j])
+    assert tr.job_slice(4) == []
+
+
+# ---------------------------------------------------------------------------
+# logger prefix
+# ---------------------------------------------------------------------------
+
+def test_logger_job_prefix(capsys):
+    from racon_tpu.utils.logger import Logger
+
+    lg = Logger()
+    lg.log()
+    lg.log("bare stage")
+    with obs_context.job_context(5, "tenantA"):
+        lg.log("ctx stage")
+    err = capsys.readouterr().err.splitlines()
+    assert re.fullmatch(r"bare stage \d+\.\d{6} s", err[0]), err
+    assert re.fullmatch(r"\[job 5/tenantA\] ctx stage \d+\.\d{6} s",
+                        err[1]), err
+
+
+# ---------------------------------------------------------------------------
+# inspect renderers (pure)
+# ---------------------------------------------------------------------------
+
+_EVENTS = [
+    {"seq": 1, "t": 10.0, "kind": "admit", "job": 17,
+     "tenant": "tenantA", "priority": 0, "predicted_wall_s": 4.1,
+     "queue_depth": 1},
+    {"seq": 2, "t": 10.012, "kind": "start", "job": 17,
+     "tenant": "tenantA", "queue_wait_s": 0.012},
+    {"seq": 3, "t": 10.64, "kind": "fused_dispatch",
+     "jobs": [17, 18], "unit_kind": "poa", "units": 2, "items": 96,
+     "occupancy": 0.75, "tenants": ["tenantA", "tenantB"]},
+    {"seq": 4, "t": 12.31, "kind": "done", "job": 17,
+     "tenant": "tenantA", "ok": True, "exec_wall_s": 2.298},
+    {"seq": 5, "t": 13.0, "kind": "drain", "queued": 0, "running": 1},
+]
+
+
+def test_inspect_job_events_filter_spans_fused():
+    evs = serve_inspect.job_events(_EVENTS, 17)
+    assert [ev["seq"] for ev in evs] == [1, 2, 3, 4]
+    # job 18 only rode the fused dispatch
+    assert [ev["seq"] for ev in serve_inspect.job_events(
+        _EVENTS, 18)] == [3]
+
+
+def test_inspect_timeline_render():
+    out = serve_inspect.render_timeline(_EVENTS, 17)
+    assert out.startswith("job 17 (tenantA) — 4 flight event(s)")
+    assert "queue wait 0.012s" in out
+    assert "poa units=2 items=96 occupancy=0.75" in out
+    assert "tenants=tenantA,tenantB" in out
+    assert "ok exec_wall=2.298s" in out
+    # relative times from the job's first event
+    assert "+    0.000s  admit" in out
+    assert "+    2.310s  done" in out
+    # trace appendix interleaves on the same timebase (ts is µs
+    # since the epoch; flight t is seconds since the epoch)
+    out = serve_inspect.render_timeline(
+        _EVENTS, 17,
+        trace_events=[{"name": "serve.exec", "ph": "X",
+                       "ts": 10.012e6, "dur": 2.298e6}])
+    assert "trace slice — 1 event(s)" in out
+    assert "serve.exec dur=2.298s" in out
+    # unknown job: explicit, not a crash
+    assert "no events" in serve_inspect.render_timeline(_EVENTS, 99)
+
+
+def test_inspect_summary_render():
+    out = serve_inspect.render_summary(_EVENTS)
+    assert "job 17" in out and "tenant=tenantA" in out
+    assert "admit,start,fused_dispatch,done" in out
+    assert "[drain] queued=0 running=1" in out
+
+
+def test_status_human_tenant_rows(capsys):
+    """``racon-tpu status`` (human mode) renders the per-tenant
+    queued/running rows with serve_tenant_wait_s percentiles."""
+    from unittest import mock
+
+    from racon_tpu.obs.metrics import Registry
+
+    reg = Registry()
+    reg.observe("serve_tenant_wait_s.tenantA", 0.01)
+    reg.observe("serve_tenant_wait_s.tenantA", 0.02)
+    doc = {"ok": True, "pid": 1, "socket": "/tmp/x.sock",
+           "uptime_s": 5.0, "draining": False,
+           "queue": {"queue_depth": 0, "max_queue": 8, "running": [],
+                     "max_jobs": 2, "completed": 2, "paused": False,
+                     "draining": False,
+                     "tenants": {
+                         "tenantA": {"queued": 1, "running": 0},
+                         "tenantB": {"queued": 0, "running": 1}}},
+           "registry": reg.snapshot()}
+    with mock.patch.object(client, "status", return_value=doc):
+        assert client.main_status(["--socket", "/tmp/x.sock"]) == 0
+    out = capsys.readouterr().out
+    assert re.search(r"tenantA\s+1\s+0\s+\d+/\d+/\d+ ms", out), out
+    assert re.search(r"tenantB\s+0\s+1\s+-", out), out
+
+
+# ---------------------------------------------------------------------------
+# scheduler flight events (in-process, stub runner)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def fresh_flight():
+    obs_flight._reset_for_tests()
+    yield obs_flight.FLIGHT
+    obs_flight._reset_for_tests()
+
+
+def _tiny_spec(tmp_path, tenant="tA"):
+    paths = {}
+    for key in ("sequences", "overlaps", "targets"):
+        p = tmp_path / f"{key}.txt"
+        p.write_text("x" * 1000)
+        paths[key] = str(p)
+    paths["tenant"] = tenant
+    return paths
+
+
+def test_scheduler_leaves_flight_events(tmp_path, fresh_flight):
+    from racon_tpu.serve.scheduler import JobScheduler, RejectError
+
+    gate = threading.Event()
+    seen = {}
+
+    def runner(job):
+        seen["ctx"] = obs_context.current()
+        gate.wait(30)
+        return {"ok": True}
+
+    sched = JobScheduler(runner, max_queue=1, max_jobs=1)
+    try:
+        job = sched.submit(_tiny_spec(tmp_path))
+        # wait until the worker recorded "start" (which also means
+        # the job is in the running set)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if any(ev["kind"] == "start"
+                   for ev in fresh_flight.snapshot()):
+                break
+            time.sleep(0.02)
+        # per-tenant rows in the queue snapshot (status --json / top)
+        snap = sched.snapshot()
+        assert snap["tenants"] == {"tA": {"queued": 0, "running": 1}}
+        # job 2 fills the 1-slot queue; job 3 overflows it — the
+        # reject leaves a flight event too
+        job2 = sched.submit(_tiny_spec(tmp_path, tenant="tB"))
+        with pytest.raises(RejectError):
+            sched.submit(_tiny_spec(tmp_path, tenant="tC"))
+        assert sched.snapshot()["tenants"]["tB"] == {
+            "queued": 1, "running": 0}
+        gate.set()
+        assert job.done.wait(60) and job.result["ok"]
+        assert job2.done.wait(60) and job2.result["ok"]
+    finally:
+        gate.set()
+        sched.drain(60)
+    # the runner executed inside the job's context
+    assert seen["ctx"].job_id == job2.id
+    assert seen["ctx"].tenant == "tB"
+    kinds = [ev["kind"] for ev in fresh_flight.snapshot()]
+    assert kinds.count("admit") == 2 and kinds.count("done") == 2
+    reject = next(ev for ev in fresh_flight.snapshot()
+                  if ev["kind"] == "reject")
+    assert reject["code"] == "queue_full" and reject["tenant"] == "tC"
+    # the job-filtered view is exactly one job's life
+    evs = fresh_flight.snapshot(job=job.id)
+    assert [ev["kind"] for ev in evs] == ["admit", "start", "done"]
+    admit, start, done = evs
+    assert admit["tenant"] == "tA" and admit["predicted_wall_s"] >= 0
+    assert "queue_depth" in admit
+    assert start["queue_wait_s"] >= 0
+    assert done["ok"] is True and done["exec_wall_s"] >= 0
+
+
+def test_scheduler_error_event_carries_traceback(tmp_path,
+                                                 fresh_flight):
+    from racon_tpu.serve.scheduler import JobScheduler
+
+    def runner(job):
+        raise RuntimeError("runner exploded")
+
+    sched = JobScheduler(runner, max_queue=1, max_jobs=1)
+    try:
+        job = sched.submit(_tiny_spec(tmp_path))
+        assert job.done.wait(60)
+        assert not job.result["ok"]
+    finally:
+        sched.drain(60)
+    errs = [ev for ev in fresh_flight.snapshot(job=job.id)
+            if ev["kind"] == "error"]
+    assert errs and "runner exploded" in errs[0]["error"]
+    assert "RuntimeError" in errs[0]["traceback"]
+    done = [ev for ev in fresh_flight.snapshot(job=job.id)
+            if ev["kind"] == "done"]
+    assert done and done[0]["ok"] is False
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: CLI hard-exit dump + byte identity, daemon forensics
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_tmp():
+    with tempfile.TemporaryDirectory(prefix="rtflight_",
+                                     dir="/tmp") as d:
+        yield d
+
+
+@pytest.fixture(scope="module")
+def dataset(serve_tmp):
+    from racon_tpu.tools import simulate
+
+    return simulate.simulate(os.path.join(serve_tmp, "data"),
+                             genome_len=8_000, coverage=5,
+                             read_len=800, seed=33, ont=True)
+
+
+def _env(serve_tmp, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "RACON_TPU_CACHE_DIR": os.path.join(serve_tmp, "cache"),
+        "RACON_TPU_CLI_PREWARM": "0",
+        "RACON_TPU_RATE_POA_DEV": "0.30",
+        "RACON_TPU_RATE_POA_CPU": "2.0",
+        "RACON_TPU_RATE_ALIGN_DEV": "1100",
+        "RACON_TPU_RATE_ALIGN_CPU": "4.0",
+        "RACON_TPU_RATE_ALIGN_WFA_DEV": "700",
+        "RACON_TPU_RATE_ALIGN_WFA_CPU": "1.0",
+    })
+    for k in ("RACON_TPU_TRACE", "RACON_TPU_METRICS_JSON",
+              "RACON_TPU_FLIGHT_DUMP"):
+        env.pop(k, None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _cli(dataset, serve_tmp, extra_env=None, args=()):
+    reads, paf, draft = dataset
+    return subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "4", "-c", "1",
+         "--tpualigner-batches", "1", *args, reads, paf, draft],
+        cwd=REPO_ROOT, capture_output=True,
+        env=_env(serve_tmp, extra_env), timeout=600)
+
+
+@pytest.fixture(scope="module")
+def golden(dataset, serve_tmp):
+    """Obs-off one-shot bytes — the identity reference."""
+    run = _cli(dataset, serve_tmp,
+               extra_env={"RACON_TPU_FLIGHT": "0"})
+    assert run.returncode == 0, run.stderr.decode()
+    assert run.stdout.startswith(b">")
+    return run.stdout
+
+
+def test_cli_flight_dump_survives_hard_exit(dataset, serve_tmp,
+                                            golden, tmp_path):
+    """The r14 fix: cli.main ends in os._exit(0); the flight dump
+    (and --trace buffer) must be flushed BEFORE it.  Flight + trace
+    on must also change zero output bytes vs the obs-off golden."""
+    dump = str(tmp_path / "cli-flight.json")
+    trace = str(tmp_path / "cli-trace.json")
+    run = _cli(dataset, serve_tmp,
+               extra_env={"RACON_TPU_FLIGHT": "1",
+                          "RACON_TPU_FLIGHT_DUMP": dump},
+               args=("--trace", trace))
+    assert run.returncode == 0, run.stderr.decode()
+    assert run.stdout == golden, (
+        "flight-on + traced run diverged from the obs-off bytes")
+    assert "flight dump written to" in run.stderr.decode()
+    doc = obs_flight.load_dump(dump)
+    assert doc["reason"] == "run_done"
+    kinds = [ev["kind"] for ev in doc["events"]]
+    assert kinds[0] == "run" and kinds[-1] == "run_done"
+    assert doc["events"][-1]["n_sequences"] > 0
+    # the trace buffer was flushed through the same pre-exit path
+    with open(trace) as f:
+        tdoc = json.load(f)
+    assert len(tdoc["traceEvents"]) > 1
+
+
+def _spec(dataset, tenant="default"):
+    reads, paf, draft = dataset
+    return {"sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 4, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1, "tenant": tenant}
+
+
+def _start_server(serve_tmp, name, args=(), extra_env=None):
+    sock_path = os.path.join(serve_tmp, name + ".sock")
+    log = open(os.path.join(serve_tmp, name + ".log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve",
+         "--socket", sock_path, *args],
+        cwd=REPO_ROOT, stdout=log, stderr=log,
+        env=_env(serve_tmp, extra_env))
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            log.close()
+            raise AssertionError(
+                "server died at startup: " + open(log.name).read())
+        if os.path.exists(sock_path):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock_path)
+            except OSError:
+                pass
+            else:
+                log.close()
+                return proc, sock_path
+            finally:
+                probe.close()
+        time.sleep(0.2)
+    proc.kill()
+    log.close()
+    raise AssertionError("server socket never came up")
+
+
+def _inspect(args):
+    return subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "inspect", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+
+
+def test_daemon_forensics_e2e(dataset, serve_tmp, golden):
+    """One daemon, fusion forced, flight dump pinned: submit
+    --trace, the flight op, inspect --socket, SIGTERM mid-job,
+    inspect --dump."""
+    dump = os.path.join(serve_tmp, "daemon-flight.json")
+    proc, sock = _start_server(
+        serve_tmp, "forensics", args=("--jobs", "2"),
+        extra_env={"RACON_TPU_FUSE_FORCE": "1",
+                   "RACON_TPU_FLIGHT_DUMP": dump})
+    try:
+        # --- submit --trace: per-job slice rides the response ------
+        resp = client.submit(sock, _spec(dataset, tenant="tenantA"),
+                             want_trace=True)
+        assert resp["ok"], resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden, (
+            "traced served job diverged from the obs-off bytes")
+        jid = resp["job_id"]
+        tevs = resp["trace_events"]
+        assert tevs, "submit --trace returned an empty trace slice"
+        names = {ev.get("name") for ev in tevs}
+        assert "serve.exec" in names
+        fused = [ev for ev in tevs
+                 if ev.get("name") == "executor.fused_dispatch"]
+        assert fused, (
+            "no fused-dispatch span attributed to the job: %s"
+            % sorted(names))
+        assert "occupancy" in fused[0]["args"]
+        assert "tenantA" in fused[0]["args"]["tenants"]
+        # every event in the slice is tagged with the job identity
+        exec_ev = next(ev for ev in tevs
+                       if ev.get("name") == "serve.exec")
+        assert exec_ev["args"]["job"] == jid
+        fkinds = [ev["kind"] for ev in resp["flight_events"]]
+        assert {"admit", "start", "done"} <= set(fkinds)
+
+        # --- flight op: live ring + job filter + trace slice -------
+        doc = client.flight(sock)
+        assert doc["ok"] and doc["ring"]["size"] >= 3
+        doc = client.flight(sock, job=jid)
+        assert {"admit", "start", "done"} <= {
+            ev["kind"] for ev in doc["events"]}
+        assert any(ev.get("name") == "serve.exec"
+                   for ev in doc["job_trace"])
+
+        # --- inspect --socket: rendered timeline -------------------
+        run = _inspect(["--socket", sock, "--job", str(jid)])
+        assert run.returncode == 0, run.stderr
+        assert f"job {jid} (tenantA)" in run.stdout
+        assert "queue wait" in run.stdout
+        assert "fused_dispatch" in run.stdout
+        assert "occupancy=" in run.stdout
+        assert "done" in run.stdout and "exec_wall=" in run.stdout
+        run = _inspect(["--socket", sock])
+        assert run.returncode == 0, run.stderr
+        assert f"job {jid}" in run.stdout
+
+        # --- per-tenant rows in status/top sources -----------------
+        q = client.status(sock)["queue"]
+        assert "tenants" in q
+
+        # --- SIGTERM mid-job: drain, then a dump with the story ----
+        held = {}
+        t1 = threading.Thread(target=lambda: held.update(
+            r=client.submit(sock, _spec(dataset, tenant="tenantB"))))
+        t1.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if len(client.status(sock)["queue"]["running"]) >= 1:
+                break
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        t1.join(timeout=300)
+        assert not t1.is_alive() and held["r"]["ok"], held.get("r")
+        jid2 = held["r"]["job_id"]
+        assert base64.b64decode(held["r"]["fasta_b64"]) == golden
+        assert proc.wait(timeout=60) == 0
+
+        # the shutdown dump exists and carries the drained job's
+        # admit/exec events plus the drain marker
+        doc = obs_flight.load_dump(dump)
+        assert doc["reason"] == "drain"
+        kinds = {ev["kind"] for ev in doc["events"]}
+        assert "drain" in kinds
+        jkinds = [ev["kind"] for ev in doc["events"]
+                  if ev.get("job") == jid2]
+        assert {"admit", "start", "done"} <= set(jkinds), jkinds
+
+        # --- inspect --dump: post-mortem render --------------------
+        run = _inspect(["--dump", dump, "--job", str(jid2)])
+        assert run.returncode == 0, run.stderr
+        assert f"job {jid2} (tenantB)" in run.stdout
+        assert "admit" in run.stdout and "queue wait" in run.stdout
+        run = _inspect(["--dump", dump])
+        assert run.returncode == 0, run.stderr
+        assert "[drain]" in run.stdout
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -52,13 +52,18 @@ def validate_chrome_trace(doc) -> set:
     names = set()
     for ev in events:
         assert isinstance(ev.get("name"), str) and ev["name"]
-        assert ev.get("ph") in ("X", "i", "M"), ev
+        # s/t/f are flow events (r14: executor fused-dispatch
+        # attribution arrows); they carry an id instead of a dur
+        assert ev.get("ph") in ("X", "i", "M", "s", "t", "f"), ev
         assert isinstance(ev.get("pid"), int)
         assert isinstance(ev.get("tid"), int)
         if ev["ph"] == "X":
             assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
             assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
             names.add(ev["name"])
+        if ev["ph"] in ("s", "t", "f"):
+            assert isinstance(ev.get("id"), int)
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
         if "args" in ev:
             json.dumps(ev["args"])   # args must be JSON-serializable
 
@@ -399,12 +404,17 @@ def test_cli_trace_and_metrics_json(obs_dataset, tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_no_raw_timing_outside_obs():
-    """New raw time.monotonic()/perf_counter() timing belongs in
-    racon_tpu/obs (use obs.now()/span()); utils/logger.py keeps its
-    own clock to preserve the reference's exact stderr format.  The
-    grep twin of this lint runs in ci/cpu/obs_tier1.sh."""
-    pat = re.compile(r"time\.monotonic\(|time\.perf_counter\(")
-    allowed = {os.path.join("racon_tpu", "utils", "logger.py")}
+    """New raw time.monotonic()/perf_counter()/time.time() timing
+    belongs in racon_tpu/obs (use obs.now()/span()); utils/logger.py
+    keeps its own clock to preserve the reference's exact stderr
+    format, and tools/wrapper.py stamps scratch filenames with
+    wall-clock time (an identifier, not a measurement).  The grep
+    twins of this lint run in ci/cpu/obs_tier1.sh and
+    ci/cpu/forensics_tier1.sh."""
+    pat = re.compile(
+        r"time\.monotonic\(|time\.perf_counter\(|time\.time\(")
+    allowed = {os.path.join("racon_tpu", "utils", "logger.py"),
+               os.path.join("racon_tpu", "tools", "wrapper.py")}
     offenders = []
     pkg = os.path.join(REPO_ROOT, "racon_tpu")
     for dirpath, _, files in os.walk(pkg):
