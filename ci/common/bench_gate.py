@@ -20,6 +20,15 @@ table — when a watched metric regressed past its threshold:
   throughput jitters with the same host factors walls do).
 * ``deterministic: false`` in the fresh record fails outright.
 
+The gate also checks trajectory FRESHNESS: when the newest committed
+``BENCH_r*.json`` predates the newest commit touching perf-affecting
+paths (``racon_tpu/``, ``bench.py``), it prints a distinct
+non-fatal ``STALE-TRAJECTORY WARNING`` — the reference numbers then
+describe older (typically slower) code, so the gate is lenient and
+the trajectory should be regenerated (run bench.py on the target
+host, commit the record; see README).  The check needs git history
+and silently skips when there is none (temp ``--trajectory`` dirs).
+
 The reference value for each metric is the **median of the newest
 three** trajectory records that carry it — one outlier round cannot
 poison the gate, and newly added metrics gate as soon as one round
@@ -41,6 +50,7 @@ import argparse
 import glob
 import json
 import os
+import subprocess
 import sys
 
 #: wall-clock legs, seconds, lower is better (relative threshold)
@@ -190,6 +200,50 @@ def format_table(rows: list) -> str:
     return "\n".join(lines)
 
 
+#: paths whose commits can move the numbers the trajectory records
+PERF_PATHS = ("racon_tpu/", "bench.py")
+
+
+def _newest_commit_epoch(directory: str, paths) -> int:
+    """Unix epoch of the newest commit touching ``paths`` (git log),
+    or None when git/history is unavailable (not a repo, no commits
+    touching the paths, git missing)."""
+    try:
+        out = subprocess.run(
+            ["git", "log", "-1", "--format=%ct", "--"] + list(paths),
+            cwd=directory, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if out.returncode != 0 or not out.stdout.strip():
+        return None
+    try:
+        return int(out.stdout.strip().splitlines()[0])
+    except ValueError:
+        return None
+
+
+def staleness_warning(directory: str):
+    """A human-readable warning when the newest committed BENCH
+    record predates the newest perf-affecting commit — i.e. the
+    trajectory no longer describes the code being gated.  Returns
+    None when fresh, or when git history is unavailable (temp
+    --trajectory dirs are not repos; staleness is advisory, never a
+    reason to fail)."""
+    bench_epoch = _newest_commit_epoch(directory, ["BENCH_r*.json"])
+    perf_epoch = _newest_commit_epoch(directory, PERF_PATHS)
+    if bench_epoch is None or perf_epoch is None:
+        return None
+    if bench_epoch >= perf_epoch:
+        return None
+    lag = perf_epoch - bench_epoch
+    return (f"newest BENCH_r*.json commit predates the newest "
+            f"perf-affecting commit (racon_tpu//bench.py) by "
+            f"{lag / 86400:.1f} day(s) — the reference trajectory "
+            f"does not describe the current code; re-run bench.py "
+            f"on the target host and commit the new BENCH_r*.json "
+            f"(see README 'Bench regression gate')")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Gate a fresh bench JSON against the committed "
@@ -231,6 +285,12 @@ def main(argv=None) -> int:
     names = ", ".join(n for n, _ in trajectory[-3:])
     print(f"[bench_gate] reference: median of newest <=3 of "
           f"{len(trajectory)} record(s) ({names})", file=sys.stderr)
+    stale = staleness_warning(directory)
+    if stale:
+        # advisory only: a stale reference makes the gate LENIENT
+        # (old, slower numbers), so warn loudly but never fail on it
+        print(f"[bench_gate] STALE-TRAJECTORY WARNING: {stale}",
+              file=sys.stderr)
     print(format_table(rows), file=sys.stderr)
     failed = [r for r in rows if r["fail"]]
     if failed:

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Fleet-lane tier-1 (ISSUE 11 CI satellite): boots TWO polishing
+# daemons and runs the fleet telemetry suite —
+#   * exact cross-daemon aggregation: merged-histogram p50/p90/p99
+#     pinned bit-for-bit equal to the union stream's for random
+#     shard assignments (racon_tpu/obs/aggregate.py);
+#   * wire trace-context propagation: one client trace id must land
+#     in both daemons' flight events, span args, and `inspect`
+#     timelines end-to-end;
+#   * fleet scrape + attribution: `top --fleet --once --json` and
+#     `metrics --fleet --json|--prometheus` rows carry the correct
+#     daemon identity (instance labels, not name mangling), dead
+#     targets degrade to stale rows, multiplexed watch streams keep
+#     per-source seq numbering;
+#   * the byte contract: a daemon under active fleet scrape serves
+#     FASTA byte-identical to the unscraped one-shot CLI;
+#   * the bench-gate staleness guard (hermetic temp git repo).
+# Hardening matches the serve/telemetry lanes:
+#   * JAX_PLATFORMS=cpu + 8 virtual devices (tests/conftest.py)
+#     exercises the sharded dispatch path without hardware;
+#   * PYTHONDEVMODE=1 surfaces unclosed sockets/files and unjoined
+#     threads in the scraper/watch-multiplexer;
+#   * pytest's faulthandler timeout dumps EVERY thread's traceback
+#     if a test hangs, so a stuck scrape or watch reader shows up
+#     as a stack dump naming the blocked wait instead of an opaque
+#     CI timeout.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export PYTHONDEVMODE=1
+python -m pytest tests/test_fleet.py -q \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"

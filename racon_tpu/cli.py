@@ -29,18 +29,26 @@ USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequenc
        racon-tpu serve --socket PATH [options ...]
        racon-tpu submit --socket PATH [options ...] <sequences> <overlaps> <target sequences>
        racon-tpu status --socket PATH [--json]
-       racon-tpu top --socket PATH [--interval S] [--once] [--json]
+       racon-tpu top (--socket PATH | --fleet S1,S2,..) [--interval S] [--once] [--json]
+       racon-tpu metrics (--socket PATH | --fleet S1,S2,..) [--json|--prometheus]
        racon-tpu inspect (--socket PATH | --dump FILE) [--job N] [--json]
 
     subcommands (racon_tpu/serve — persistent polishing service):
         serve    start the warm-kernel job daemon on a unix socket
         submit   run one polish through a daemon (same options and
                  stdout contract as the one-shot form; --trace FILE
-                 saves the job's server-side trace slice)
+                 saves the job's server-side trace slice;
+                 --trace-context ID propagates a caller trace id
+                 into the daemon's spans and flight events)
         status   print a daemon's queue/registry/provenance snapshot
                  (--json for the raw document)
-        top      live telemetry view over the daemon's watch stream
+        top      live telemetry view over the daemon's watch stream;
+                 --fleet polls many daemons and renders per-daemon
+                 rows + the exactly-merged fleet SLO table
                  (--once --json for one machine-readable frame)
+        metrics  one-shot telemetry scrape of one daemon or a fleet,
+                 as JSON or Prometheus text (fleet samples carry
+                 instance="<daemon_id>" labels)
         inspect  render a job's timeline (queue wait, exec, fused
                  dispatches with occupancy) from a live daemon's
                  flight recorder or a post-mortem flight dump
@@ -251,6 +259,9 @@ def main(argv=None):
     if argv and argv[0] == "top":
         from racon_tpu.serve import top as serve_top
         raise SystemExit(serve_top.main(argv[1:]))
+    if argv and argv[0] == "metrics":
+        from racon_tpu.serve import fleet as serve_fleet
+        raise SystemExit(serve_fleet.main_metrics(argv[1:]))
     if argv and argv[0] == "inspect":
         from racon_tpu.serve import inspect as serve_inspect
         raise SystemExit(serve_inspect.main(argv[1:]))

@@ -28,6 +28,11 @@ dispatch) whose timing story needs first-class tooling:
   (``job_id``/``tenant``/``trace_id`` contextvar) entered by the
   serve scheduler around each job; the tracer, flight recorder and
   logger auto-tag whatever is recorded under it.
+* :mod:`racon_tpu.obs.aggregate` — exact cross-process merging of
+  registry snapshots (counters sum, gauges keep per-source values,
+  fixed-ladder histograms merge bucket-wise so fleet percentiles are
+  bit-for-bit the union stream's) — the substrate of the r15 fleet
+  telemetry plane (racon_tpu/serve/fleet.py).
 * :mod:`racon_tpu.obs.flight` — an always-on bounded ring of
   structured events (admits, rejects, fused dispatches, errors with
   tracebacks), dumped on crash/drain and readable live over the
@@ -45,8 +50,9 @@ ci/cpu/obs_tier1.sh and tests/test_obs.py fails on raw
 
 from __future__ import annotations
 
+from racon_tpu.obs.aggregate import merge_histograms, merge_snapshots
 from racon_tpu.obs.context import (JobContext, current, job_context,
-                                   jobs_for_tenant)
+                                   jobs_for_tenant, valid_trace_id)
 from racon_tpu.obs.devutil import DEVICE_UTIL, DeviceUtil
 from racon_tpu.obs.flight import FLIGHT, FlightRecorder
 from racon_tpu.obs.metrics import (HIST_BUCKETS, REGISTRY, MetricAttr,
@@ -59,5 +65,6 @@ __all__ = [
     "HIST_BUCKETS", "hist_quantile", "DEVICE_UTIL", "DeviceUtil",
     "now", "span", "device_span", "enable_trace", "write_trace",
     "JobContext", "job_context", "current", "jobs_for_tenant",
-    "FLIGHT", "FlightRecorder",
+    "valid_trace_id", "FLIGHT", "FlightRecorder",
+    "merge_histograms", "merge_snapshots",
 ]

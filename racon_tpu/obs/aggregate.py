@@ -1,0 +1,110 @@
+"""Exact cross-process aggregation of registry snapshots.
+
+The fleet telemetry plane (racon_tpu/serve/fleet.py) scrapes N
+daemons, each exporting one ``Registry.snapshot()``; a router pricing
+jobs from fleet-level p99s needs those snapshots MERGED, and merged
+*exactly* — an approximate merge would make the fleet SLO table
+disagree with what any single daemon would have reported for the same
+observation stream.  Exactness falls out of two registry design
+choices (racon_tpu/obs/metrics.py):
+
+* every histogram shares the one fixed log-spaced bucket ladder
+  (:data:`~racon_tpu.obs.metrics.HIST_BUCKETS`), never derived from
+  observed data — so bucket index i means the same interval in every
+  process and buckets merge by integer addition;
+* :func:`~racon_tpu.obs.metrics.hist_quantile` reads only ``count``,
+  ``buckets``, ``min`` and ``max`` — all of which merge exactly
+  (sums of integers, min of mins, max of maxes).  The float ``sum``
+  field is carried for the exposition but never feeds a quantile, so
+  float addition order cannot perturb a percentile.
+
+Hence the pinned property (tests/test_fleet.py): shard one
+observation stream across N registries any way you like —
+``hist_quantile(merge(snapshots), q)`` is bit-for-bit equal to
+``hist_quantile`` of the single registry that saw the whole stream.
+
+Merged-snapshot schema (``merge_snapshots``)::
+
+    {"schema": "racon-tpu-aggregate-v1",
+     "sources": ["d1", "d2", ...],          # the snapshot keys, sorted
+     "counters": {name: total},             # summed across sources
+     "gauges": {name: {"per_source": {src: v},
+                       "min": .., "max": .., "sum": ..}},
+     "histograms": {name: merged_entry}}    # single-snapshot shape
+
+Gauges are NOT summed into one number: a gauge is a point-in-time
+reading (queue depth, uptime) whose cross-daemon sum is usually
+meaningless — the per-source map keeps attribution and min/max/sum
+are provided for the cases (depths) where they do mean something.
+Merged histogram entries keep the exact single-snapshot shape, so
+every existing consumer (``hist_quantile``, ``export.percentiles``,
+``export.slo_summary``) works on a merged snapshot unchanged.
+
+Read-side only: merging renders what already happened and writes no
+registry (determinism contract, racon_tpu/obs/__init__.py).
+"""
+
+from __future__ import annotations
+
+SCHEMA = "racon-tpu-aggregate-v1"
+
+
+def merge_histograms(hists) -> dict:
+    """Merge histogram snapshot entries (same fixed bucket ladder)
+    bucket-wise.  Accepts any iterable of entries; empty/None entries
+    are skipped.  Returns a single-snapshot-shaped entry."""
+    merged = None
+    for h in hists:
+        if not h or not h.get("count"):
+            continue
+        if merged is None:
+            merged = {"count": 0, "sum": 0.0,
+                      "min": h["min"], "max": h["max"], "buckets": {}}
+        merged["count"] += int(h["count"])
+        merged["sum"] += float(h.get("sum", 0.0))
+        merged["min"] = min(merged["min"], h["min"])
+        merged["max"] = max(merged["max"], h["max"])
+        for k, n in (h.get("buckets") or {}).items():
+            key = str(int(k))
+            merged["buckets"][key] = \
+                merged["buckets"].get(key, 0) + int(n)
+    return merged if merged is not None else \
+        {"count": 0, "sum": 0.0, "buckets": {}}
+
+
+def merge_snapshots(snapshots: dict) -> dict:
+    """Merge ``{source_id: Registry.snapshot()}`` into one aggregate
+    document (see the module docstring for the schema).  Sources
+    missing a metric simply contribute nothing to it; a source may be
+    a raw snapshot or an ``export.json_snapshot`` (the extra
+    ``percentiles`` keys are ignored)."""
+    sources = sorted(snapshots)
+    counters: dict = {}
+    gauges: dict = {}
+    hist_names: dict = {}
+    for src in sources:
+        snap = snapshots[src] or {}
+        for name, v in (snap.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + v
+        for name, v in (snap.get("gauges") or {}).items():
+            row = gauges.setdefault(name, {"per_source": {}})
+            row["per_source"][src] = v
+            if isinstance(v, bool):
+                v = int(v)
+            if isinstance(v, (int, float)):
+                row["min"] = min(row.get("min", v), v)
+                row["max"] = max(row.get("max", v), v)
+                row["sum"] = row.get("sum", 0) + v
+        for name in (snap.get("histograms") or {}):
+            hist_names.setdefault(name, []).append(src)
+    histograms = {
+        name: merge_histograms(
+            snapshots[src]["histograms"][name] for src in srcs)
+        for name, srcs in hist_names.items()}
+    return {
+        "schema": SCHEMA,
+        "sources": sources,
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": histograms,
+    }

@@ -29,6 +29,7 @@ racon_tpu/obs/__init__.py, pinned in tests/test_flight.py).
 from __future__ import annotations
 
 import os
+import re
 import threading
 from contextlib import contextmanager
 from contextvars import ContextVar
@@ -56,6 +57,20 @@ def make_trace_id(job_id) -> str:
     daemons without any randomness (nothing here may perturb
     reproducibility)."""
     return f"{os.getpid():08x}-{int(job_id):06d}"
+
+
+#: wire-supplied trace contexts (r15): traceparent-style opaque ids —
+#: short, printable, no whitespace — so a caller id is safe to embed
+#: in trace args, flight events and log lines verbatim
+_TRACE_ID = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._:-]{0,127}$")
+
+
+def valid_trace_id(s) -> bool:
+    """True when ``s`` is acceptable as a caller-supplied trace
+    context on a ``submit`` frame: 1..128 chars of
+    ``[A-Za-z0-9._:-]`` starting alphanumeric.  The ids
+    :func:`make_trace_id` mints always pass."""
+    return isinstance(s, str) and bool(_TRACE_ID.match(s))
 
 
 def current() -> Optional[JobContext]:

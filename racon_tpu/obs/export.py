@@ -2,22 +2,34 @@
 
 The PR 4 registry made every run's counters readable in-process; a
 persistent daemon (racon_tpu/serve) needs them readable from the
-OUTSIDE — a scraper, the ``racon-tpu top`` client, the future fleet
-router.  This module renders a :class:`racon_tpu.obs.metrics.Registry`
-snapshot two ways:
+OUTSIDE — a scraper, the ``racon-tpu top`` client, the fleet scrape
+tier (racon_tpu/serve/fleet.py).  This module renders a
+:class:`racon_tpu.obs.metrics.Registry` snapshot several ways:
 
 * :func:`prometheus_text` — Prometheus text exposition (format 0.0.4):
   counters/gauges as single samples, bucketed histograms as cumulative
   ``_bucket{le="..."}`` series + ``_sum``/``_count``, all under the
   ``racon_tpu_`` prefix.  Registry names are free-form (dots, rung
   suffixes like ``align_rung_admit.wfa2048``); :func:`sanitize` maps
-  them onto the Prometheus grammar deterministically.
+  them onto the Prometheus grammar deterministically.  The per-tenant
+  SLO histograms (``serve_tenant_wait_s.<t>``,
+  ``serve_queue_wait_s.<t>``) are exported under their BASE metric
+  name with a ``tenant`` label instead of a sanitized name suffix —
+  ``sanitize`` is not injective, so two tenants whose names differ
+  only in punctuation would otherwise collide into one series
+  (round-trip pinned in tests/test_fleet.py).
+* :func:`prometheus_text_fleet` — one exposition over MANY daemons'
+  snapshots, every sample labeled ``instance="<daemon_id>"`` (one
+  TYPE line per metric) — per-daemon attribution without name
+  mangling, the fleet analog of a Prometheus federation page.
 * :func:`json_snapshot` — the raw snapshot with per-histogram
   p50/p90/p99 attached, for machine consumers that want numbers
   without a Prometheus parser.
 * :func:`parse_prometheus_text` — a minimal exposition parser used by
   the round-trip tests (and any Python-side scraper): recovers the
-  counters/gauges/histograms keyed by their sanitized names.
+  counters/gauges/histograms keyed by their sanitized names; labeled
+  series are keyed ``name{k="v",...}`` with the labels in sorted-key
+  canonical form (``le`` excluded — it keys the bucket map instead).
 
 Nothing here writes the registry: export renders what already
 happened (determinism contract, racon_tpu/obs/__init__.py).
@@ -36,19 +48,47 @@ _INVALID = re.compile(r"[^a-zA-Z0-9_]")
 #: quantiles attached to every exported histogram
 QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
 
+#: registry-name prefixes whose dot-suffix is a tenant tag exported
+#: as a ``tenant`` label (racon_tpu/serve/scheduler.py and
+#: racon_tpu/tpu/executor.py write these per-tenant SLO series)
+TENANT_SERIES = ("serve_tenant_wait_s", "serve_queue_wait_s")
+
 
 def sanitize(name: str) -> str:
     """Registry name -> Prometheus metric name (prefixed, every
     character outside ``[a-zA-Z0-9_]`` folded to ``_``).  The mapping
-    is deterministic but not injective — two registry names that
-    differ only in punctuation collide, which the free-form registry
-    namespace never produces in practice."""
+    is deterministic but not injective — which is exactly why tenant
+    tags travel as labels (:data:`TENANT_SERIES`), never as folded
+    name suffixes."""
     san = _INVALID.sub("_", name)
     # the reject-code names carry a leading '-' ("poa_reject.-1");
     # folding gives a double underscore, which is legal — but a name
     # must not START with a digit after the prefix is applied, and
     # the prefix guarantees that
     return PREFIX + san
+
+
+def split_tenant(name: str):
+    """``serve_tenant_wait_s.alice`` -> ``("serve_tenant_wait_s",
+    {"tenant": "alice"})``; any other name -> ``(name, {})``."""
+    for base in TENANT_SERIES:
+        if name.startswith(base + ".") and len(name) > len(base) + 1:
+            return base, {"tenant": name[len(base) + 1:]}
+    return name, {}
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _label_str(labels: dict) -> str:
+    """Canonical (sorted-key) label block, ``""`` when empty."""
+    if not labels:
+        return ""
+    return "{" + ",".join(
+        f'{k}="{_escape_label(labels[k])}"'
+        for k in sorted(labels)) + "}"
 
 
 def _fmt(v) -> str:
@@ -58,50 +98,105 @@ def _fmt(v) -> str:
     return repr(f)
 
 
+def _render(sources) -> str:
+    """Exposition over ``[(base_labels, snapshot), ...]``: one TYPE
+    line per metric name, every sample carrying its source's base
+    labels (plus ``tenant`` for the per-tenant series, plus ``le``
+    for buckets)."""
+    counters: dict = {}
+    gauges: dict = {}
+    hists: dict = {}
+    for base_labels, snap in sources:
+        for name in sorted(snap.get("counters", {})):
+            base, tl = split_tenant(name)
+            counters.setdefault(sanitize(base), []).append(
+                ({**base_labels, **tl}, snap["counters"][name]))
+        for name in sorted(snap.get("gauges", {})):
+            v = snap["gauges"][name]
+            if isinstance(v, bool):
+                v = int(v)
+            if not isinstance(v, (int, float)):
+                continue   # non-numeric gauges have no exposition form
+            base, tl = split_tenant(name)
+            gauges.setdefault(sanitize(base), []).append(
+                ({**base_labels, **tl}, v))
+        for name in sorted(snap.get("histograms", {})):
+            base, tl = split_tenant(name)
+            hists.setdefault(sanitize(base), []).append(
+                ({**base_labels, **tl}, snap["histograms"][name]))
+    lines = []
+    for mn in sorted(counters):
+        lines.append(f"# TYPE {mn} counter")
+        for labels, v in counters[mn]:
+            lines.append(f"{mn}{_label_str(labels)} {_fmt(v)}")
+    for mn in sorted(gauges):
+        lines.append(f"# TYPE {mn} gauge")
+        for labels, v in gauges[mn]:
+            lines.append(f"{mn}{_label_str(labels)} {_fmt(v)}")
+    for mn in sorted(hists):
+        lines.append(f"# TYPE {mn} histogram")
+        for labels, h in hists[mn]:
+            counts = {int(k): v
+                      for k, v in h.get("buckets", {}).items()}
+            cum = 0
+            for idx in sorted(counts):
+                cum += counts[idx]
+                le = _fmt(HIST_BUCKETS[idx]) \
+                    if idx < len(HIST_BUCKETS) else "+Inf"
+                if le != "+Inf":
+                    ls = _label_str({**labels, "le": le})
+                    lines.append(f"{mn}_bucket{ls} {cum}")
+            ls = _label_str({**labels, "le": "+Inf"})
+            lines.append(f'{mn}_bucket{ls} {h["count"]}')
+            lines.append(
+                f"{mn}_sum{_label_str(labels)} {_fmt(h['sum'])}")
+            lines.append(
+                f"{mn}_count{_label_str(labels)} {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
 def prometheus_text(snapshot: dict) -> str:
     """Render a registry snapshot (``Registry.snapshot()``) as
     Prometheus text exposition."""
-    lines = []
-    for name in sorted(snapshot.get("counters", {})):
-        mn = sanitize(name)
-        lines.append(f"# TYPE {mn} counter")
-        lines.append(f"{mn} {_fmt(snapshot['counters'][name])}")
-    for name in sorted(snapshot.get("gauges", {})):
-        mn = sanitize(name)
-        v = snapshot["gauges"][name]
-        if isinstance(v, bool):
-            v = int(v)
-        if not isinstance(v, (int, float)):
-            continue   # non-numeric gauges have no exposition form
-        lines.append(f"# TYPE {mn} gauge")
-        lines.append(f"{mn} {_fmt(v)}")
-    for name in sorted(snapshot.get("histograms", {})):
-        h = snapshot["histograms"][name]
-        mn = sanitize(name)
-        lines.append(f"# TYPE {mn} histogram")
-        counts = {int(k): v for k, v in h.get("buckets", {}).items()}
-        cum = 0
-        for idx in sorted(counts):
-            cum += counts[idx]
-            le = _fmt(HIST_BUCKETS[idx]) if idx < len(HIST_BUCKETS) \
-                else "+Inf"
-            if le != "+Inf":
-                lines.append(f'{mn}_bucket{{le="{le}"}} {cum}')
-        lines.append(f'{mn}_bucket{{le="+Inf"}} {h["count"]}')
-        lines.append(f"{mn}_sum {_fmt(h['sum'])}")
-        lines.append(f"{mn}_count {h['count']}")
-    return "\n".join(lines) + "\n"
+    return _render([({}, snapshot)])
+
+
+def prometheus_text_fleet(snapshots: dict) -> str:
+    """Render ``{instance_id: snapshot}`` as ONE exposition where
+    every sample carries ``instance="<id>"`` — the fleet scrape
+    tier's merged-but-attributed view (``racon-tpu metrics --fleet
+    --prometheus``)."""
+    return _render([({"instance": iid}, snapshots[iid])
+                    for iid in sorted(snapshots)])
 
 
 _SAMPLE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
-    r'(?:\{le="(?P<le>[^"]+)"\})?\s+(?P<value>\S+)$')
+    r'(?:\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*='
+    r'"(?:[^"\\]|\\.)*",?)*)\})?'
+    r'\s+(?P<value>\S+)$')
+
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+_UNESCAPE = {"n": "\n"}
+
+
+def _parse_labels(blob) -> dict:
+    if not blob:
+        return {}
+    return {k: re.sub(r"\\(.)",
+                      lambda m: _UNESCAPE.get(m.group(1),
+                                              m.group(1)), v)
+            for k, v in _LABEL.findall(blob)}
 
 
 def parse_prometheus_text(text: str) -> dict:
-    """Parse :func:`prometheus_text` output back into
-    ``{"counters": .., "gauges": .., "histograms": ..}`` keyed by the
-    SANITIZED metric names.  Histograms come back as ``{"count", "sum",
+    """Parse :func:`prometheus_text` /
+    :func:`prometheus_text_fleet` output back into ``{"counters": ..,
+    "gauges": .., "histograms": ..}`` keyed by the SANITIZED metric
+    names — plus a canonical sorted-key label block
+    (``name{instance="d1",tenant="a.b"}``) when a sample carries
+    labels beyond ``le``.  Histograms come back as ``{"count", "sum",
     "buckets": {le_string: cumulative_count}}``.  Raises ValueError on
     a malformed line — the round-trip test doubles as a format
     validator."""
@@ -120,7 +215,9 @@ def parse_prometheus_text(text: str) -> dict:
         m = _SAMPLE.match(line)
         if not m:
             raise ValueError(f"malformed exposition line: {line!r}")
-        name, le, value = m.group("name", "le", "value")
+        name, blob, value = m.group("name", "labels", "value")
+        labels = _parse_labels(blob)
+        le = labels.pop("le", None)
         value = float(value)
         base = name
         for suffix in ("_bucket", "_sum", "_count"):
@@ -129,9 +226,10 @@ def parse_prometheus_text(text: str) -> dict:
                 base = name[:-len(suffix)]
                 break
         kind = types.get(base)
+        key = base + _label_str(labels)
         if kind == "histogram":
             h = out["histograms"].setdefault(
-                base, {"count": 0, "sum": 0.0, "buckets": {}})
+                key, {"count": 0, "sum": 0.0, "buckets": {}})
             if name.endswith("_bucket"):
                 h["buckets"][le] = value
             elif name.endswith("_sum"):
@@ -141,9 +239,9 @@ def parse_prometheus_text(text: str) -> dict:
             else:
                 raise ValueError(f"stray histogram sample: {line!r}")
         elif kind == "counter":
-            out["counters"][name] = value
+            out["counters"][key] = value
         elif kind == "gauge":
-            out["gauges"][name] = value
+            out["gauges"][key] = value
         else:
             raise ValueError(f"sample without a TYPE line: {line!r}")
     return out
@@ -178,7 +276,9 @@ def json_snapshot(snapshot: dict) -> dict:
 def slo_summary(snapshot: dict, prefix: str = "serve_") -> dict:
     """Percentile summary of every histogram under ``prefix`` — the
     serving-tier SLO view (queue_wait/exec_wall/e2e_wall/wall error)
-    that ``watch`` frames and ``racon-tpu top`` render."""
+    that ``watch`` frames and ``racon-tpu top`` render.  Works on a
+    plain snapshot or an :func:`racon_tpu.obs.aggregate
+    .merge_snapshots` document (same histogram shape)."""
     return {name: percentiles(h)
             for name, h in snapshot.get("histograms", {}).items()
             if name.startswith(prefix)}

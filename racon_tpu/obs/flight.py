@@ -93,9 +93,12 @@ class FlightRecorder:
 
     def record(self, kind: str, job=None, tenant=None,
                **fields) -> None:
-        """Append one event.  ``job``/``tenant`` default from the
-        active job context (racon_tpu/obs/context.py) so call sites
-        inside a job need no plumbing."""
+        """Append one event.  ``job``/``tenant``/``trace_id`` default
+        from the active job context (racon_tpu/obs/context.py) so
+        call sites inside a job need no plumbing; sites outside the
+        context (admission, the worker's start/done bookends) pass
+        ``trace_id=...`` explicitly so a wire-propagated trace
+        context (r15) reaches every event of the job it names."""
         if not enabled():
             return
         ctx = _context.current()
@@ -104,6 +107,8 @@ class FlightRecorder:
                 job = ctx.job_id
             if tenant is None:
                 tenant = ctx.tenant
+            if fields.get("trace_id") is None:
+                fields["trace_id"] = ctx.trace_id
         ev = {"kind": kind, "t": round(
             _trace.epoch_offset(_trace.now()), 6)}
         if job is not None:

@@ -82,6 +82,13 @@ KNOWN_KNOBS = {
     "RACON_TPU_FLIGHT": "1",
     "RACON_TPU_FLIGHT_RING": "4096",
     "RACON_TPU_FLIGHT_DUMP": "",
+    # fleet telemetry plane (r15, racon_tpu/serve/fleet.py): scrape
+    # period of the background fleet scraper, per-target request
+    # timeout, and the age past which a daemon's last-known snapshot
+    # is reported stale
+    "RACON_TPU_FLEET_INTERVAL_S": "1.0",
+    "RACON_TPU_FLEET_TIMEOUT_S": "5.0",
+    "RACON_TPU_FLEET_STALE_S": "10.0",
 }
 
 # host-capability probe reference wall (bench.py's budget scaling):
@@ -161,6 +168,48 @@ def host_probe() -> dict:
         out["budget_factor"] = 1.0
     _probe_cache.append(out)
     return out
+
+
+_identity_cache: dict = {}
+
+
+def daemon_identity(socket_path: str = None) -> dict:
+    """Stable identity block for a serve daemon — attached to every
+    ``metrics``/``health``/``watch``/``status`` frame so a fleet
+    scraper (racon_tpu/serve/fleet.py) can attribute telemetry to a
+    process, not a socket path: sockets get reused across restarts,
+    ``daemon_id`` never is (it hashes host+socket+pid+start wall
+    time).  The static fields are computed once per process per
+    socket; ``backend`` is re-read each call because jax only imports
+    after prewarm.  Lives in obs/ because the start epoch is a
+    wall-clock stamp (an identifier, not a measurement) — the one
+    place raw ``time.time`` is sanctioned (see the obs timing
+    lint)."""
+    import hashlib
+    import socket as _socket
+    import time
+
+    key = socket_path or ""
+    if key not in _identity_cache:
+        host = _socket.gethostname()
+        start = time.time()
+        raw = f"{host}|{key}|{os.getpid()}|{start:.6f}"
+        import racon_tpu
+
+        _identity_cache[key] = {
+            "daemon_id":
+                hashlib.sha1(raw.encode()).hexdigest()[:12],
+            "host": host,
+            "pid": os.getpid(),
+            "socket": key or None,
+            "start_epoch": round(start, 3),
+            "version": racon_tpu.__version__,
+        }
+    ident = dict(_identity_cache[key])
+    ji = jax_info()
+    ident["backend"] = ji.get("backend") if ji.get("imported") \
+        else None
+    return ident
 
 
 def environment(probe: bool = True) -> dict:

@@ -24,6 +24,12 @@ server, applied to polishing:
   report embedded in the response.
 * :mod:`racon_tpu.serve.client` — the blocking client and the
   ``racon-tpu submit`` / ``racon-tpu status`` subcommands.
+* :mod:`racon_tpu.serve.fleet` — the r15 fleet telemetry plane: a
+  concurrent multi-daemon ``metrics`` scraper with per-target
+  staleness, the exact cross-daemon registry merge
+  (racon_tpu/obs/aggregate.py), multiplexed ``watch`` streams, and
+  the ``racon-tpu metrics`` one-shot CLI; ``racon-tpu top --fleet``
+  renders the merged view.
 
 Determinism contract: a served job's FASTA is byte-identical to a
 standalone CLI run with the same inputs/flags/threads/devices — the

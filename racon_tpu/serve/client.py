@@ -60,14 +60,21 @@ def request(socket_path: str, frame: dict, timeout: float = None):
 
 
 def submit(socket_path: str, spec: dict, priority: int = 0,
-           timeout: float = None, want_trace: bool = False) -> dict:
+           timeout: float = None, want_trace: bool = False,
+           trace_context: str = None) -> dict:
     """Submit one job and block until it completes (or is rejected).
     Returns the raw response frame; callers check ``resp["ok"]``.
     ``want_trace`` asks the server to attach the job's trace slice
-    (``trace_events``) and flight events (``flight_events``)."""
+    (``trace_events``) and flight events (``flight_events``).
+    ``trace_context`` is an optional caller-chosen trace id
+    (traceparent-style, ``[A-Za-z0-9._:-]{1,128}``); the daemon
+    adopts it as the job's trace id so spans, flight events and
+    ``inspect`` timelines across daemons share one id."""
     frame = {"op": "submit", "job": spec, "priority": priority}
     if want_trace:
         frame["trace"] = True
+    if trace_context is not None:
+        frame["trace_context"] = trace_context
     return request(socket_path, frame, timeout=timeout)
 
 
@@ -161,9 +168,10 @@ def spec_from_opts(opts: dict, inputs, tenant: str = None) -> dict:
 
 
 def _split_serve_flags(argv):
-    """Pull --socket/--priority/--tenant out of the argv so the rest
-    parses with the unchanged one-shot ``cli.parse_args``."""
-    socket_path, priority, tenant = None, 0, None
+    """Pull --socket/--priority/--tenant/--trace-context out of the
+    argv so the rest parses with the unchanged one-shot
+    ``cli.parse_args``."""
+    socket_path, priority, tenant, trace_context = None, 0, None, None
     rest = []
     i = 0
     while i < len(argv):
@@ -183,16 +191,22 @@ def _split_serve_flags(argv):
             tenant = argv[i] if i < len(argv) else None
         elif a.startswith("--tenant="):
             tenant = a.split("=", 1)[1]
+        elif a == "--trace-context":
+            i += 1
+            trace_context = argv[i] if i < len(argv) else None
+        elif a.startswith("--trace-context="):
+            trace_context = a.split("=", 1)[1]
         else:
             rest.append(a)
         i += 1
-    return socket_path, priority, tenant, rest
+    return socket_path, priority, tenant, trace_context, rest
 
 
 def main_submit(argv) -> int:
     from racon_tpu import cli
 
-    socket_path, priority, tenant, rest = _split_serve_flags(argv)
+    socket_path, priority, tenant, trace_context, rest = \
+        _split_serve_flags(argv)
     if not socket_path:
         print("[racon_tpu::submit] error: --socket PATH is required!",
               file=sys.stderr)
@@ -206,7 +220,8 @@ def main_submit(argv) -> int:
         resp = submit(socket_path,
                       spec_from_opts(opts, inputs, tenant=tenant),
                       priority=priority,
-                      want_trace=bool(opts["trace"]))
+                      want_trace=bool(opts["trace"]),
+                      trace_context=trace_context)
     except ServeError as exc:
         print(f"[racon_tpu::submit] error: {exc}", file=sys.stderr)
         return 1
@@ -258,7 +273,7 @@ def main_submit(argv) -> int:
 
 
 def main_status(argv) -> int:
-    socket_path, _, _, rest = _split_serve_flags(argv)
+    socket_path, _, _, _, rest = _split_serve_flags(argv)
     as_json = "--json" in rest
     rest = [a for a in rest if a != "--json"]
     if not socket_path or rest:

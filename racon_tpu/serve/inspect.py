@@ -91,8 +91,14 @@ def render_timeline(events, job: int, trace_events=None) -> str:
                 f"the ring, or never seen here)\n")
     tenant = next((ev["tenant"] for ev in sel if "tenant" in ev),
                   "default")
+    # the job's trace id (r15: possibly wire-propagated by the
+    # caller) rides the header so timelines from different daemons
+    # correlate by eye
+    trace = next((ev["trace_id"] for ev in sel
+                  if ev.get("trace_id")), None)
+    who = f"{tenant}, trace {trace}" if trace else tenant
     t0 = sel[0].get("t", 0.0)
-    lines = [f"job {job} ({tenant}) — {len(sel)} flight event(s)"]
+    lines = [f"job {job} ({who}) — {len(sel)} flight event(s)"]
     for ev in sel:
         dt = ev.get("t", t0) - t0
         lines.append(f"  +{dt:9.3f}s  {ev.get('kind', '?'):<15s} "

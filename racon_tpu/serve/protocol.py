@@ -63,6 +63,22 @@ Forensics ops (r14, racon_tpu/obs/flight.py):
   trace events tagged ``{job, tenant, trace_id}``) and its flight
   events (``flight_events``) — the ``racon-tpu inspect`` /
   ``submit --trace`` source.
+
+Fleet ops (r15, racon_tpu/serve/fleet.py):
+
+* ``submit`` may carry ``trace_context`` (string, 1..128 chars of
+  ``[A-Za-z0-9._:-]`` starting alphanumeric — traceparent-style):
+  the daemon adopts it as the job's trace id, so spans, flight
+  events and ``inspect`` timelines from DIFFERENT daemons handling
+  parts of one logical request share a trace id end-to-end.  A
+  malformed value is ``bad_request``; absent, the daemon mints its
+  own deterministic ``<pid>-<job>`` id as before.
+* ``metrics`` / ``health`` / ``watch`` / ``status`` responses carry
+  an ``identity`` block (``daemon_id`` — stable 12-hex digest of
+  host/socket/pid/start, plus ``host``/``pid``/``socket``/
+  ``start_epoch``/``version``/``backend``) so a fleet scraper
+  attributes every frame to a PROCESS, not a socket path that may
+  be reused across restarts.
 """
 
 from __future__ import annotations

@@ -112,12 +112,21 @@ class Job:
     """One queued submission: spec + completion rendezvous."""
 
     def __init__(self, job_id: int, spec: dict, priority: int,
-                 estimate: dict, tenant: str = "default"):
+                 estimate: dict, tenant: str = "default",
+                 trace_context: str = None):
         self.id = job_id
         self.spec = spec
         self.priority = priority
         self.estimate = estimate
         self.tenant = tenant
+        # the job's trace id is fixed AT ADMISSION: a caller-supplied
+        # wire trace context (r15) wins, else the deterministic
+        # per-process id — so the admit flight event, the worker's
+        # job context and every span/flight event inside the job all
+        # carry the same id, across however many daemons a logical
+        # request touched
+        self.trace_id = trace_context or \
+            obs_context.make_trace_id(job_id)
         self.t_submit: Optional[float] = None   # admission timestamp
         self.done = threading.Event()
         self.result: Optional[dict] = None   # set exactly once
@@ -161,23 +170,35 @@ class JobScheduler:
 
     # -- admission -----------------------------------------------------
 
-    def submit(self, spec: dict, priority: int = 0) -> Job:
+    def submit(self, spec: dict, priority: int = 0,
+               trace_context: str = None) -> Job:
         """Admit a job or raise :class:`RejectError`.  Never blocks on
         queue capacity — backpressure is an immediate structured
-        reject, so a full server answers in microseconds."""
+        reject, so a full server answers in microseconds.
+        ``trace_context`` is the caller's wire trace id (r15): the
+        job adopts it as its trace id, so forensics from every daemon
+        a logical request touched stitch on one id."""
         try:
-            return self._submit(spec, priority)
+            return self._submit(spec, priority, trace_context)
         except RejectError as exc:
             obs_flight.FLIGHT.record(
                 "reject",
                 tenant=(spec.get("tenant")
                         if isinstance(spec, dict) else None),
                 code=exc.error.get("code"),
+                trace_id=trace_context,
                 predicted_wall_s=(exc.error.get("estimate") or {})
                 .get("predicted_wall_s"))
             raise
 
-    def _submit(self, spec: dict, priority: int) -> Job:
+    def _submit(self, spec: dict, priority: int,
+                trace_context: str = None) -> Job:
+        if trace_context is not None and \
+                not obs_context.valid_trace_id(trace_context):
+            raise RejectError({
+                "code": "bad_request",
+                "reason": "trace_context must be 1..128 chars of "
+                          "[A-Za-z0-9._:-] starting alphanumeric"})
         for key in ("sequences", "overlaps", "targets"):
             path = spec.get(key)
             if not isinstance(path, str):
@@ -228,7 +249,7 @@ class JobScheduler:
                     "max_queue": self.max_queue,
                     "running": len(self._running)})
             job = Job(next(self._ids), spec, priority, estimate,
-                      tenant=tenant)
+                      tenant=tenant, trace_context=trace_context)
             job.t_submit = obs_trace.now()
             heapq.heappush(self._heap, (-priority, next(self._seq),
                                         job))
@@ -239,10 +260,12 @@ class JobScheduler:
             obs_trace.TRACER.add_instant(
                 "serve.submit", cat="serve",
                 args={"job": job.id, "tenant": tenant,
+                      "trace_id": job.trace_id,
                       "priority": priority,
                       "queue_depth": len(self._heap)})
             obs_flight.FLIGHT.record(
                 "admit", job=job.id, tenant=tenant,
+                trace_id=job.trace_id,
                 priority=priority,
                 predicted_wall_s=round(
                     estimate.get("predicted_wall_s", 0.0), 4),
@@ -284,6 +307,7 @@ class JobScheduler:
                     f"serve_queue_wait_s.{job.tenant}", queue_wait)
             obs_flight.FLIGHT.record(
                 "start", job=job.id, tenant=job.tenant,
+                trace_id=job.trace_id,
                 queue_wait_s=(round(queue_wait, 6)
                               if queue_wait is not None else None))
             # the job is a device-executor tenant for its lifetime:
@@ -297,7 +321,8 @@ class JobScheduler:
             # the job context makes everything recorded during this
             # job's execution — spans, flight events, log lines —
             # attributable to (job, tenant) with no call-site plumbing
-            with obs_context.job_context(job.id, job.tenant):
+            with obs_context.job_context(job.id, job.tenant,
+                                         trace_id=job.trace_id):
                 try:
                     result = self._runner(job)
                 except Exception as exc:  # runner bug: job fails,
@@ -315,9 +340,11 @@ class JobScheduler:
             obs_trace.TRACER.add_span(
                 "serve.exec", t_pop, t_done, cat="serve",
                 args={"job": job.id, "tenant": job.tenant,
+                      "trace_id": job.trace_id,
                       "ok": bool(result.get("ok"))})
             obs_flight.FLIGHT.record(
                 "done", job=job.id, tenant=job.tenant,
+                trace_id=job.trace_id,
                 ok=bool(result.get("ok")),
                 exec_wall_s=round(exec_wall, 6))
             REGISTRY.observe("serve_exec_wall_s", exec_wall)

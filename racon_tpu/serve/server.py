@@ -51,6 +51,7 @@ import sys
 import threading
 
 from racon_tpu.obs import REGISTRY
+from racon_tpu.obs import context as obs_context
 from racon_tpu.obs import flight as obs_flight
 from racon_tpu.obs import trace as obs_trace
 from racon_tpu.serve import protocol
@@ -83,6 +84,18 @@ class PolishServer:
         # flight ring if any thread dies with an unhandled exception
         obs_trace.TRACER.enable_job_capture()
         obs_flight.FLIGHT.install_dump_on_crash()
+        # fleet identity (r15): pin the static identity fields (id,
+        # pid, start epoch) at construction so every frame this
+        # daemon ever answers carries the same daemon_id
+        from racon_tpu.obs import provenance
+        provenance.daemon_identity(socket_path)
+
+    def _identity(self) -> dict:
+        """The daemon's stable identity block — on every
+        ``metrics``/``health``/``watch``/``status`` frame, so a fleet
+        scraper attributes telemetry to a process, not a socket."""
+        from racon_tpu.obs import provenance
+        return provenance.daemon_identity(self.socket_path)
 
     # -- warm state ----------------------------------------------------
 
@@ -108,9 +121,17 @@ class PolishServer:
         if not isinstance(spec, dict):
             return protocol.error_frame("bad_request",
                                         "submit carries no job object")
+        trace_context = req.get("trace_context")
+        if trace_context is not None and \
+                not obs_context.valid_trace_id(trace_context):
+            return protocol.error_frame(
+                "bad_request",
+                "trace_context must be 1..128 chars of "
+                "[A-Za-z0-9._:-] starting alphanumeric")
         try:
             job = self.scheduler.submit(
-                spec, priority=int(req.get("priority", 0)))
+                spec, priority=int(req.get("priority", 0)),
+                trace_context=trace_context)
         except RejectError as exc:
             return {"ok": False, "error": exc.error}
         job.done.wait()
@@ -134,6 +155,7 @@ class PolishServer:
             "ok": True,
             "pid": os.getpid(),
             "socket": self.socket_path,
+            "identity": self._identity(),
             "uptime_s": round(obs_trace.now() - self._t_start, 3),
             "draining": self.scheduler.draining,
             "queue": self.scheduler.snapshot(),
@@ -163,6 +185,7 @@ class PolishServer:
         doc = {
             "ok": True,
             "pid": os.getpid(),
+            "identity": self._identity(),
             "uptime_s": snap["gauges"]["serve_uptime_s"],
             "queue": self.scheduler.snapshot(),
             "device_util": du,
@@ -189,6 +212,7 @@ class PolishServer:
         doc = {
             "ok": True,
             "pid": os.getpid(),
+            "identity": self._identity(),
             "ring": obs_flight.FLIGHT.stats(),
             "events": obs_flight.FLIGHT.snapshot(job=job, last=last),
         }
@@ -198,17 +222,27 @@ class PolishServer:
 
     def _health_doc(self) -> dict:
         """Liveness/readiness without a registry walk — cheap enough
-        for a tight poll loop."""
+        for a tight poll loop.  r15 adds the internal depths a fleet
+        overseer triages with: the flight-ring fill, the device
+        executor's fusion-queue backlog, and the in-flight job
+        count."""
+        from racon_tpu.tpu import executor as device_executor
+
         q = self.scheduler.snapshot()
         return {
             "ok": True,
             "status": "draining" if q["draining"] else "ok",
             "pid": os.getpid(),
+            "identity": self._identity(),
             "uptime_s": round(obs_trace.now() - self._t_start, 3),
             "accepting": not q["draining"],
             "queue_depth": q["queue_depth"],
             "running": len(q["running"]),
+            "in_flight_jobs": len(q["running"]),
             "paused": q["paused"],
+            "flight_ring_depth": obs_flight.FLIGHT.stats()["size"],
+            "fusion_queue_depth":
+                device_executor.get_executor().pending_units(),
         }
 
     def _handle_watch(self, conn, req: dict) -> None:

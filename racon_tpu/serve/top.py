@@ -1,19 +1,28 @@
-"""``racon-tpu top``: live terminal status for a polishing daemon.
+"""``racon-tpu top``: live terminal status for a polishing daemon —
+or, with ``--fleet``, for several at once.
 
-Subscribes to a server's ``watch`` stream
+Single-daemon mode subscribes to a server's ``watch`` stream
 (racon_tpu/serve/client.py) and renders each telemetry frame as a
 compact terminal dashboard — queue state, per-engine device
 utilization, serving-SLO latency percentiles — refreshed in place
 when stderr is a TTY (ANSI home+clear), appended as plain text
 otherwise.
 
-Machine mode: ``--once --json`` prints exactly one telemetry frame
-as one JSON line and exits — the scripting/router interface (queue
+Fleet mode (``--fleet SOCK1,SOCK2,...``) polls every socket through
+the scrape tier (racon_tpu/serve/fleet.py) and renders one
+per-daemon row each (identity, state, queue occupancy; dead/stale
+daemons stay visible as DOWN/STALE rows) above a merged fleet SLO
+table whose percentiles are the EXACT quantiles of the union of all
+daemons' observation streams (racon_tpu/obs/aggregate.py).
+
+Machine mode: ``--once --json`` prints exactly one frame (the
+telemetry frame, or the merged fleet document with ``--fleet``) as
+one JSON line and exits — the scripting/router interface (queue
 depth + predicted pressure per daemon is the fleet-routing signal
 the ROADMAP calls for).
 
-The client is read-only: every op it sends (``watch``) touches no
-queue or job state on the server.
+The client is read-only: every op it sends (``watch``/``metrics``)
+touches no queue or job state on the server.
 """
 
 from __future__ import annotations
@@ -21,6 +30,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 from racon_tpu.serve import client
 
@@ -102,13 +112,67 @@ def render(doc: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_fleet(doc: dict) -> str:
+    """One merged fleet document (racon_tpu/serve/fleet.py
+    ``merge_fleet``) -> the dashboard text (pure function; the tests
+    golden it without a terminal)."""
+    lines = [
+        f"racon-tpu fleet  {doc.get('fleet_size', 0)} daemon(s)  "
+        f"{doc.get('alive', 0)} alive  {doc.get('stale', 0)} stale"]
+    lines.append("")
+    lines.append("daemon        pid      state     up        "
+                 "queued  running  done")
+    for d in doc.get("daemons", ()):
+        ident = d.get("identity") or {}
+        did = (ident.get("daemon_id") or d.get("target", "?"))[:12]
+        pid = str(ident.get("pid") or "-")
+        if not ident:
+            state = "DOWN"       # never answered: no identity known
+        elif d.get("stale"):
+            state = "STALE"
+        elif d.get("draining"):
+            state = "draining"
+        else:
+            state = "up"
+        up = (_fmt_s(d["uptime_s"])
+              if d.get("uptime_s") is not None else "-")
+        qd = d.get("queue_depth")
+        done = d.get("completed")
+        lines.append(
+            f"{did:<12s}  {pid:<7s}  {state:<8s}  {up:<8s}  "
+            f"{'-' if qd is None else qd!s:>6s}  "
+            f"{d.get('running', 0)!s:>7s}  "
+            f"{'-' if done is None else done!s:>4s}")
+        if d.get("error") and state in ("DOWN", "STALE"):
+            lines.append(f"              ! {d['error']}")
+
+    slo = doc.get("slo") or {}
+    if slo:
+        lines.append("")
+        lines.append("fleet slo              count   p50       "
+                     "p90       p99")
+        for name in sorted(slo):
+            s = slo[name]
+            if not s.get("count"):
+                continue
+            lines.append(
+                f"{name:<22s} {s['count']:>5d}   "
+                f"{_fmt_s(s['p50']):<8s}  {_fmt_s(s['p90']):<8s}  "
+                f"{_fmt_s(s['p99']):<8s}")
+    return "\n".join(lines) + "\n"
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="racon-tpu top",
-        description="Live status view of a racon-tpu serve daemon "
-        "over its watch stream.")
-    p.add_argument("--socket", required=True,
+        description="Live status view of one racon-tpu serve daemon "
+        "(watch stream) or a fleet of them (scrape tier).")
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--socket",
                    help="unix-domain socket of the server to watch")
+    g.add_argument("--fleet", metavar="SOCK1,SOCK2,...",
+                   help="comma-separated daemon sockets; renders "
+                   "per-daemon rows + the merged fleet SLO table")
     p.add_argument("--interval", type=float, default=1.0,
                    help="refresh period in seconds (default 1.0)")
     p.add_argument("--count", type=int, default=0,
@@ -116,14 +180,43 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--once", action="store_true",
                    help="print one frame and exit (implies --count 1)")
     p.add_argument("--json", action="store_true",
-                   help="print raw telemetry frames as JSON lines "
-                   "instead of the dashboard")
+                   help="print raw frames as JSON lines instead of "
+                   "the dashboard")
     return p
+
+
+def _main_fleet(args, count: int) -> int:
+    from racon_tpu.serve import fleet
+
+    scraper = fleet.FleetScraper(
+        [t for t in args.fleet.split(",") if t])
+    live = sys.stdout.isatty() and not args.json and count != 1
+    sent = 0
+    try:
+        while True:
+            scraper.scrape_once()
+            doc = fleet.merge_fleet(scraper.results())
+            if args.json:
+                print(json.dumps(doc, separators=(",", ":")),
+                      flush=True)
+            else:
+                if live:
+                    sys.stdout.write("\x1b[H\x1b[J")
+                sys.stdout.write(render_fleet(doc))
+                sys.stdout.flush()
+            sent += 1
+            if count and sent >= count:
+                return 0 if doc.get("ok") else 1
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
     count = 1 if args.once else args.count
+    if args.fleet:
+        return _main_fleet(args, count)
     live = sys.stdout.isatty() and not args.json and count != 1
     try:
         for doc in client.watch(args.socket,

@@ -1,0 +1,663 @@
+"""Fleet telemetry plane (racon_tpu/obs/aggregate, serve/fleet) — ISSUE 11.
+
+Two layers:
+
+* **pure** — the exact cross-registry histogram merge (bit-for-bit
+  quantile equality with the union stream, any shard assignment),
+  merged-snapshot schema, tenant-label round-trip (colliding tenant
+  names stay distinct), fleet Prometheus exposition with
+  ``instance`` labels, trace-context validation, daemon identity
+  stability, the scrape-tier degradation paths, and the bench-gate
+  staleness guard (hermetic temp git repo);
+* **live two-daemon** — a pair of CPU-backend daemons: wire trace
+  contexts must reach both daemons' spans/flight events/inspect
+  timelines end-to-end, the fleet scraper must attribute telemetry
+  to the right daemon identity (``top --fleet`` / ``metrics
+  --fleet``), multiplexed ``watch`` streams must keep per-source
+  seq numbering, and a daemon under active fleet scrape must serve
+  bytes identical to the unscraped one-shot CLI.
+"""
+
+import base64
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from racon_tpu.obs import aggregate as obs_aggregate   # noqa: E402
+from racon_tpu.obs import context as obs_context       # noqa: E402
+from racon_tpu.obs import export as obs_export         # noqa: E402
+from racon_tpu.obs import metrics as obs_metrics       # noqa: E402
+from racon_tpu.obs import provenance as obs_prov       # noqa: E402
+from racon_tpu.serve import client                     # noqa: E402
+from racon_tpu.serve import fleet as serve_fleet       # noqa: E402
+from racon_tpu.serve import top as serve_top           # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO_ROOT, "ci", "common", "bench_gate.py")
+
+
+# ---------------------------------------------------------------------------
+# exact histogram merging: the tentpole property
+# ---------------------------------------------------------------------------
+
+def test_merged_quantiles_bit_for_bit_equal_union_stream():
+    """THE exactness pin: shard one observation stream across N
+    registries randomly; every p50/p90/p99 of the merge must be
+    bit-for-bit (==, not approx) the single-registry quantile."""
+    rng = random.Random(1234)
+    for n_shards in (1, 2, 3, 7):
+        single = obs_metrics.Registry()
+        shards = [obs_metrics.Registry() for _ in range(n_shards)]
+        for _ in range(400):
+            v = rng.lognormvariate(0.0, 3.0)   # spans many buckets
+            single.observe("serve_exec_wall_s", v)
+            shards[rng.randrange(n_shards)].observe(
+                "serve_exec_wall_s", v)
+        merged = obs_aggregate.merge_snapshots(
+            {f"d{i}": r.snapshot() for i, r in enumerate(shards)})
+        mh = merged["histograms"]["serve_exec_wall_s"]
+        sh = single.snapshot()["histograms"]["serve_exec_wall_s"]
+        for q in (0.5, 0.9, 0.99):
+            assert obs_metrics.hist_quantile(mh, q) == \
+                obs_metrics.hist_quantile(sh, q), (n_shards, q)
+        assert mh["count"] == sh["count"] == 400
+        assert mh["min"] == sh["min"] and mh["max"] == sh["max"]
+
+
+def test_merge_histograms_shapes():
+    # all empty -> the canonical empty entry
+    assert obs_aggregate.merge_histograms([]) == \
+        {"count": 0, "sum": 0.0, "buckets": {}}
+    assert obs_aggregate.merge_histograms(
+        [None, {"count": 0}]) == \
+        {"count": 0, "sum": 0.0, "buckets": {}}
+    # empty sources contribute nothing; shape stays single-snapshot
+    reg = obs_metrics.Registry()
+    reg.observe("h", 0.5)
+    h = reg.snapshot()["histograms"]["h"]
+    m = obs_aggregate.merge_histograms([None, h, {"count": 0}])
+    assert m["count"] == 1 and m["min"] == m["max"] == 0.5
+    # merged entries feed the existing consumers unchanged
+    assert obs_export.percentiles(m)["p50"] == pytest.approx(0.5)
+
+
+def test_merge_snapshots_counters_gauges_and_schema():
+    a = obs_metrics.Registry()
+    b = obs_metrics.Registry()
+    a.add("serve_admit", 3)
+    b.add("serve_admit", 4)
+    a.add("only_a", 1)
+    a.set("serve_queue_depth", 2)
+    b.set("serve_queue_depth", 5)
+    a.set("note", "text")               # non-numeric gauge
+    doc = obs_aggregate.merge_snapshots(
+        {"d2": b.snapshot(), "d1": a.snapshot()})
+    assert doc["schema"] == "racon-tpu-aggregate-v1"
+    assert doc["sources"] == ["d1", "d2"]
+    assert doc["counters"]["serve_admit"] == 7
+    assert doc["counters"]["only_a"] == 1
+    g = doc["gauges"]["serve_queue_depth"]
+    assert g["per_source"] == {"d1": 2, "d2": 5}
+    assert g["min"] == 2 and g["max"] == 5 and g["sum"] == 7
+    # non-numeric gauges keep attribution, no min/max/sum
+    assert doc["gauges"]["note"]["per_source"] == {"d1": "text"}
+    assert "min" not in doc["gauges"]["note"]
+    # slo_summary works on the merged document directly
+    a.observe("serve_e2e_wall_s", 1.0)
+    doc = obs_aggregate.merge_snapshots({"d1": a.snapshot()})
+    assert "serve_e2e_wall_s" in obs_export.slo_summary(doc)
+
+
+# ---------------------------------------------------------------------------
+# tenant labels + fleet exposition
+# ---------------------------------------------------------------------------
+
+def test_tenant_label_round_trip_colliding_names():
+    """``a.b`` and ``a_b`` sanitize to the same folded name — as
+    labels they must stay distinct series (the satellite's point)."""
+    reg = obs_metrics.Registry()
+    reg.observe("serve_queue_wait_s.a.b", 0.1)
+    reg.observe("serve_queue_wait_s.a_b", 0.2)
+    reg.observe("serve_queue_wait_s", 0.3)         # global base series
+    text = obs_export.prometheus_text(reg.snapshot())
+    assert 'tenant="a.b"' in text and 'tenant="a_b"' in text
+    back = obs_export.parse_prometheus_text(text)
+    h1 = back["histograms"]['racon_tpu_serve_queue_wait_s{tenant="a.b"}']
+    h2 = back["histograms"]['racon_tpu_serve_queue_wait_s{tenant="a_b"}']
+    hg = back["histograms"]["racon_tpu_serve_queue_wait_s"]
+    assert h1["count"] == h2["count"] == hg["count"] == 1
+    assert h1["sum"] == pytest.approx(0.1)
+    assert h2["sum"] == pytest.approx(0.2)
+
+
+def test_label_escaping_round_trip():
+    reg = obs_metrics.Registry()
+    reg.observe('serve_tenant_wait_s.we"ird\\ten', 0.5)
+    text = obs_export.prometheus_text(reg.snapshot())
+    back = obs_export.parse_prometheus_text(text)
+    key = 'racon_tpu_serve_tenant_wait_s{tenant="we\\"ird\\\\ten"}'
+    assert key in back["histograms"], list(back["histograms"])
+
+
+def test_prometheus_text_fleet_instance_labels():
+    regs = {}
+    for iid in ("aaa111", "bbb222"):
+        r = obs_metrics.Registry()
+        r.add("serve_admit", 1)
+        r.observe("serve_exec_wall_s", 0.5)
+        r.observe("serve_tenant_wait_s.t1", 0.1)
+        regs[iid] = r.snapshot()
+    text = obs_export.prometheus_text_fleet(regs)
+    # one TYPE line per metric, not per instance
+    assert text.count("# TYPE racon_tpu_serve_admit counter") == 1
+    assert 'racon_tpu_serve_admit{instance="aaa111"} 1' in text
+    assert 'racon_tpu_serve_admit{instance="bbb222"} 1' in text
+    back = obs_export.parse_prometheus_text(text)
+    assert back["counters"][
+        'racon_tpu_serve_admit{instance="aaa111"}'] == 1
+    # instance + tenant labels compose (canonical sorted-key form)
+    key = ('racon_tpu_serve_tenant_wait_s'
+           '{instance="aaa111",tenant="t1"}')
+    assert back["histograms"][key]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# trace-context validation + daemon identity
+# ---------------------------------------------------------------------------
+
+def test_valid_trace_id():
+    assert obs_context.valid_trace_id("req-1")
+    assert obs_context.valid_trace_id(
+        obs_context.make_trace_id(7))
+    assert obs_context.valid_trace_id("a" * 128)
+    assert obs_context.valid_trace_id("00-abc:span.1-01")
+    assert not obs_context.valid_trace_id("a" * 129)
+    assert not obs_context.valid_trace_id("")
+    assert not obs_context.valid_trace_id("-leading-dash")
+    assert not obs_context.valid_trace_id("has space")
+    assert not obs_context.valid_trace_id("new\nline")
+    assert not obs_context.valid_trace_id(None)
+    assert not obs_context.valid_trace_id(42)
+
+
+def test_daemon_identity_stable_per_socket():
+    i1 = obs_prov.daemon_identity("/tmp/idtest.sock")
+    i2 = obs_prov.daemon_identity("/tmp/idtest.sock")
+    other = obs_prov.daemon_identity("/tmp/idtest2.sock")
+    assert i1["daemon_id"] == i2["daemon_id"]
+    assert len(i1["daemon_id"]) == 12
+    assert i1["daemon_id"] != other["daemon_id"]
+    assert i1["pid"] == os.getpid()
+    assert i1["socket"] == "/tmp/idtest.sock"
+    assert i1["start_epoch"] > 0
+    assert isinstance(i1["version"], str)
+    assert "backend" in i1
+
+
+# ---------------------------------------------------------------------------
+# scrape-tier degradation (no daemon needed)
+# ---------------------------------------------------------------------------
+
+def test_scraper_dead_target_degrades_not_throws(tmp_path):
+    dead = os.path.join(str(tmp_path), "nope.sock")
+    s = serve_fleet.FleetScraper([dead], timeout_s=0.5,
+                                 stale_after_s=1.0)
+    s.scrape_once()
+    rows = s.results()
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["ok"] is False and row["stale"] is True
+    assert row["doc"] is None and row["consecutive_failures"] == 1
+    assert row["error"]
+    doc = serve_fleet.merge_fleet(rows)
+    assert doc["ok"] is False
+    assert doc["fleet_size"] == 1 and doc["alive"] == 0
+    assert doc["stale"] == 1
+    assert doc["merged"]["histograms"] == {}
+    # the renderer shows the dead daemon as a DOWN row, not a crash
+    text = serve_top.render_fleet(doc)
+    assert "DOWN" in text and "1 stale" in text
+
+
+def test_scraper_requires_targets():
+    with pytest.raises(ValueError):
+        serve_fleet.FleetScraper([])
+
+
+def test_fleet_knob_defaults():
+    assert serve_fleet.fleet_interval_s() > 0
+    assert serve_fleet.fleet_timeout_s() > 0
+    assert serve_fleet.fleet_stale_s() > 0
+
+
+# ---------------------------------------------------------------------------
+# bench-gate staleness guard (hermetic temp git repo)
+# ---------------------------------------------------------------------------
+
+def _git(d, *args, date=None):
+    env = dict(os.environ)
+    if date is not None:
+        env["GIT_AUTHOR_DATE"] = env["GIT_COMMITTER_DATE"] = \
+            f"@{date} +0000"
+    r = subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+        + list(args),
+        cwd=d, capture_output=True, text=True, env=env, timeout=60)
+    assert r.returncode == 0, r.stderr
+    return r
+
+
+def _run_gate(fresh: dict, directory: str):
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False) as f:
+        json.dump(fresh, f)
+    try:
+        return subprocess.run(
+            [sys.executable, GATE, f.name, "--trajectory", directory],
+            capture_output=True, text=True, timeout=60)
+    finally:
+        os.unlink(f.name)
+
+
+def test_bench_gate_staleness_warning(tmp_path):
+    d = str(tmp_path)
+    _git(d, "init", "-q")
+    with open(os.path.join(d, "BENCH_r01.json"), "w") as f:
+        json.dump({"parsed": {"value": 10.0,
+                              "deterministic": True}}, f)
+    os.makedirs(os.path.join(d, "racon_tpu"))
+    with open(os.path.join(d, "racon_tpu", "mod.py"), "w") as f:
+        f.write("x = 1\n")
+    # bench committed at t0, perf-affecting code a day LATER
+    _git(d, "add", "BENCH_r01.json", date=1_600_000_000)
+    _git(d, "commit", "-q", "-m", "bench", date=1_600_000_000)
+    _git(d, "add", "racon_tpu/mod.py", date=1_600_086_400)
+    _git(d, "commit", "-q", "-m", "perf", date=1_600_086_400)
+
+    fresh = {"value": 10.1, "deterministic": True}
+    r = _run_gate(fresh, d)
+    assert r.returncode == 0, r.stderr          # warning is non-fatal
+    assert "STALE-TRAJECTORY WARNING" in r.stderr
+    assert "re-run bench.py" in r.stderr
+
+    # newer bench commit -> fresh again, no warning
+    with open(os.path.join(d, "BENCH_r02.json"), "w") as f:
+        json.dump({"parsed": {"value": 10.0,
+                              "deterministic": True}}, f)
+    _git(d, "add", "BENCH_r02.json", date=1_600_172_800)
+    _git(d, "commit", "-q", "-m", "bench refresh", date=1_600_172_800)
+    r = _run_gate(fresh, d)
+    assert r.returncode == 0, r.stderr
+    assert "STALE-TRAJECTORY WARNING" not in r.stderr
+
+
+def test_bench_gate_staleness_silent_without_git(tmp_path):
+    d = str(tmp_path)
+    with open(os.path.join(d, "BENCH_r01.json"), "w") as f:
+        json.dump({"parsed": {"value": 10.0,
+                              "deterministic": True}}, f)
+    r = _run_gate({"value": 10.1, "deterministic": True}, d)
+    assert r.returncode == 0, r.stderr
+    assert "STALE-TRAJECTORY" not in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# live two-daemon fleet
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_tmp():
+    # unix-socket paths must stay short (~108 bytes)
+    with tempfile.TemporaryDirectory(prefix="rtflt_",
+                                     dir="/tmp") as d:
+        yield d
+
+
+@pytest.fixture(scope="module")
+def dataset(serve_tmp):
+    from racon_tpu.tools import simulate
+
+    return simulate.simulate(os.path.join(serve_tmp, "data"),
+                             genome_len=8_000, coverage=5,
+                             read_len=800, seed=21, ont=True)
+
+
+def _serve_env(serve_tmp, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "RACON_TPU_CACHE_DIR": os.path.join(serve_tmp, "cache"),
+        "RACON_TPU_CLI_PREWARM": "0",
+        "RACON_TPU_RATE_POA_DEV": "0.30",
+        "RACON_TPU_RATE_POA_CPU": "2.0",
+        "RACON_TPU_RATE_ALIGN_DEV": "1100",
+        "RACON_TPU_RATE_ALIGN_CPU": "4.0",
+        "RACON_TPU_RATE_ALIGN_WFA_DEV": "700",
+        "RACON_TPU_RATE_ALIGN_WFA_CPU": "1.0",
+    })
+    env.pop("RACON_TPU_TRACE", None)
+    env.pop("RACON_TPU_METRICS_JSON", None)
+    env.pop("RACON_TPU_SERVE_SAMPLE_S", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+@pytest.fixture(scope="module")
+def golden(dataset, serve_tmp):
+    """One-shot CLI bytes with no scraper anywhere near — the
+    reference every served-under-scrape job must match."""
+    reads, paf, draft = dataset
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "4", "-c", "1",
+         "--tpualigner-batches", "1", reads, paf, draft],
+        cwd=REPO_ROOT, capture_output=True,
+        env=_serve_env(serve_tmp), timeout=600)
+    assert run.returncode == 0, run.stderr.decode()
+    assert run.stdout.startswith(b">")
+    return run.stdout
+
+
+def _spec(dataset, tenant=None):
+    reads, paf, draft = dataset
+    spec = {"sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 4, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1}
+    if tenant:
+        spec["tenant"] = tenant
+    return spec
+
+
+def _wait_up(proc, sock_path, log):
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                "server died at startup: " + open(log).read())
+        if os.path.exists(sock_path):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock_path)
+            except OSError:
+                pass
+            else:
+                return
+            finally:
+                probe.close()
+        time.sleep(0.2)
+    proc.kill()
+    raise AssertionError("server socket never came up")
+
+
+@pytest.fixture(scope="module")
+def fleet_servers(serve_tmp):
+    """Two independent daemons — the minimal fleet."""
+    procs = []
+    socks = []
+    logs = []
+    for name in ("f1", "f2"):
+        sock_path = os.path.join(serve_tmp, f"{name}.sock")
+        log_path = os.path.join(serve_tmp, f"{name}.log")
+        log = open(log_path, "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "racon_tpu.cli", "serve",
+             "--socket", sock_path],
+            cwd=REPO_ROOT, stdout=log, stderr=log,
+            env=_serve_env(serve_tmp))
+        log.close()
+        procs.append(proc)
+        socks.append(sock_path)
+        logs.append(log_path)
+    for proc, sock_path, log_path in zip(procs, socks, logs):
+        _wait_up(proc, sock_path, log_path)
+    yield list(zip(procs, socks))
+    for proc, sock_path in zip(procs, socks):
+        if proc.poll() is None:
+            try:
+                client.admin(sock_path, "shutdown")
+            except client.ServeError:
+                proc.terminate()
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def test_trace_context_propagates_end_to_end(fleet_servers, dataset,
+                                             golden):
+    """Acceptance: one client-chosen trace id shows up in BOTH
+    daemons' flight events, span args, and inspect timelines — and
+    never perturbs bytes."""
+    trace_ctx = "req-e2e.0:fleet-11"
+    for i, (_, sock_path) in enumerate(fleet_servers):
+        resp = client.submit(sock_path,
+                             _spec(dataset, tenant=f"ten{i}"),
+                             want_trace=True,
+                             trace_context=trace_ctx)
+        assert resp["ok"], resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden, (
+            "trace context changed the served bytes")
+
+        fl = resp["flight_events"]
+        assert fl, "no flight events on the traced response"
+        for kind in ("admit", "start", "done"):
+            evs = [ev for ev in fl if ev["kind"] == kind]
+            assert evs, f"no {kind} flight event"
+            assert all(ev.get("trace_id") == trace_ctx
+                       for ev in evs), (kind, evs)
+
+        tr = resp["trace_events"]
+        tagged = [ev for ev in tr
+                  if (ev.get("args") or {}).get("trace_id")
+                  == trace_ctx]
+        assert tagged, "no span carries the wire trace id"
+        assert any(ev.get("name") == "serve.exec" for ev in tagged)
+
+        # the inspect timeline renders the id in its header
+        run = subprocess.run(
+            [sys.executable, "-m", "racon_tpu.cli", "inspect",
+             "--socket", sock_path, "--job",
+             str(resp["job_id"])],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+            timeout=60)
+        assert run.returncode == 0, run.stderr
+        assert trace_ctx in run.stdout, run.stdout
+
+
+def test_trace_context_invalid_is_bad_request(fleet_servers,
+                                              dataset):
+    _, sock_path = fleet_servers[0]
+    resp = client.submit(sock_path, _spec(dataset),
+                         trace_context="has space")
+    assert resp["ok"] is False
+    assert resp["error"]["code"] == "bad_request"
+    assert "trace_context" in resp["error"]["reason"]
+
+
+def test_trace_context_absent_keeps_minted_ids(fleet_servers,
+                                               dataset):
+    """No wire context -> the daemon's own deterministic
+    ``<pid>-<job>`` id tags the events (back-compat)."""
+    _, sock_path = fleet_servers[0]
+    resp = client.submit(sock_path, _spec(dataset), want_trace=True)
+    assert resp["ok"], resp
+    done = [ev for ev in resp["flight_events"]
+            if ev["kind"] == "done"]
+    assert done and done[-1]["trace_id"].endswith(
+        f"-{resp['job_id']:06d}")
+
+
+def test_fleet_scrape_attributes_to_identity(fleet_servers):
+    socks = [s for _, s in fleet_servers]
+    pids = {s: client.health(s)["pid"] for s in socks}
+    scraper = serve_fleet.FleetScraper(socks, timeout_s=30.0)
+    scraper.scrape_once()
+    doc = serve_fleet.merge_fleet(scraper.results())
+    assert doc["ok"] and doc["fleet_size"] == 2
+    assert doc["alive"] == 2 and doc["stale"] == 0
+    ids = set()
+    for d in doc["daemons"]:
+        ident = d["identity"]
+        assert ident["pid"] == pids[d["target"]], (
+            "telemetry attributed to the wrong daemon")
+        assert ident["socket"] == d["target"]
+        ids.add(ident["daemon_id"])
+    assert len(ids) == 2, "daemon ids must be distinct"
+    # both daemons ran jobs earlier: the merged SLO table is the
+    # union stream's
+    merged = doc["merged"]
+    assert merged["schema"] == "racon-tpu-aggregate-v1"
+    assert len(merged["sources"]) == 2
+    h = merged["histograms"].get("serve_exec_wall_s")
+    assert h and h["count"] >= 2
+    assert "serve_exec_wall_s" in doc["slo"]
+    # per-source gauges keep attribution
+    ups = merged["gauges"]["serve_uptime_s"]["per_source"]
+    assert set(ups) == ids
+
+
+def test_top_fleet_once_json(fleet_servers):
+    """Acceptance: ``top --fleet --once --json`` prints ONE JSON
+    line whose rows carry the correct daemon identities."""
+    socks = [s for _, s in fleet_servers]
+    pids = {s: client.health(s)["pid"] for s in socks}
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "top",
+         "--fleet", ",".join(socks), "--once", "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stderr
+    lines = [ln for ln in run.stdout.splitlines() if ln]
+    assert len(lines) == 1
+    doc = json.loads(lines[0])
+    assert doc["ok"] and doc["fleet_size"] == 2 and doc["alive"] == 2
+    for d in doc["daemons"]:
+        assert d["identity"]["pid"] == pids[d["target"]]
+    # the human renderer digests the same document (pure function)
+    text = serve_top.render_fleet(doc)
+    assert "racon-tpu fleet  2 daemon(s)  2 alive" in text
+    assert "fleet slo" in text
+
+
+def test_metrics_fleet_cli_json_and_prometheus(fleet_servers):
+    socks = [s for _, s in fleet_servers]
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "metrics",
+         "--fleet", ",".join(socks), "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stderr
+    doc = json.loads(run.stdout)
+    assert doc["fleet_size"] == 2 and doc["alive"] == 2
+    assert doc["merged"]["histograms"]
+
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "metrics",
+         "--fleet", ",".join(socks), "--prometheus"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stderr
+    back = obs_export.parse_prometheus_text(run.stdout)
+    instances = set()
+    for k in back["counters"]:
+        if "instance=" in k:
+            instances.add(k.split('instance="')[1].split('"')[0])
+    assert len(instances) == 2, sorted(back["counters"])[:10]
+
+    # single-daemon form still answers
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "metrics",
+         "--socket", socks[0], "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stderr
+    doc = json.loads(run.stdout)
+    assert doc["ok"] and doc["identity"]["socket"] == socks[0]
+
+
+def test_metrics_fleet_cli_partial_outage(fleet_servers, serve_tmp):
+    """One dead socket in the fleet list: merged output still comes
+    back (exit 0) with the outage reported on stderr."""
+    socks = [s for _, s in fleet_servers]
+    dead = os.path.join(serve_tmp, "dead.sock")
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "metrics",
+         "--fleet", ",".join(socks + [dead]), "--json",
+         "--timeout", "5"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stderr
+    doc = json.loads(run.stdout)
+    assert doc["fleet_size"] == 3 and doc["alive"] == 2
+    assert doc["stale"] == 1
+    assert dead in run.stderr
+
+
+def test_watch_fleet_no_cross_attribution(fleet_servers):
+    """Multiplexed watch: per-source seq stays monotone from 0 and
+    every frame's identity matches the socket it arrived from."""
+    socks = [s for _, s in fleet_servers]
+    by_target = {s: [] for s in socks}
+    for rec in serve_fleet.watch_fleet(socks, interval_s=0.1,
+                                       count=3, timeout=60):
+        by_target[rec["target"]].append(rec["frame"])
+    for s in socks:
+        frames = by_target[s]
+        assert [f["seq"] for f in frames] == [0, 1, 2], (
+            s, [f.get("seq") for f in frames])
+        for f in frames:
+            assert f["ok"]
+            assert f["identity"]["socket"] == s, (
+                "frame attributed to the wrong source")
+
+
+def test_byte_identity_under_active_fleet_scrape(fleet_servers,
+                                                dataset, golden):
+    """THE fleet determinism pin: a daemon being scraped on a tight
+    interval serves bytes identical to the unscraped one-shot."""
+    socks = [s for _, s in fleet_servers]
+    scraper = serve_fleet.FleetScraper(socks, interval_s=0.1,
+                                      timeout_s=30.0)
+    scraper.start()
+    try:
+        resp = client.submit(socks[0], _spec(dataset))
+        assert resp["ok"], resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden, (
+            "fleet scraping changed the served job's bytes")
+    finally:
+        scraper.stop()
+    # the scrape loop kept state fresh throughout
+    rows = scraper.results()
+    assert all(not r["stale"] for r in rows), rows
+
+
+def test_health_reports_internal_depths(fleet_servers):
+    _, sock_path = fleet_servers[0]
+    doc = client.health(sock_path)
+    assert doc["ok"]
+    ident = doc["identity"]
+    assert ident["pid"] == doc["pid"]
+    assert ident["socket"] == sock_path
+    assert len(ident["daemon_id"]) == 12
+    # the r15 depth fields: jobs ran on this daemon earlier, so the
+    # flight ring holds events; queues are drained between tests
+    assert doc["flight_ring_depth"] >= 1
+    assert isinstance(doc["fusion_queue_depth"], int)
+    assert doc["fusion_queue_depth"] >= 0
+    assert doc["in_flight_jobs"] == doc["running"]
+
+
+def test_status_and_watch_carry_identity(fleet_servers):
+    _, sock_path = fleet_servers[0]
+    doc = client.status(sock_path)
+    assert doc["identity"]["socket"] == sock_path
+    frames = list(client.watch(sock_path, interval_s=0.05, count=1,
+                               timeout=30))
+    assert frames[0]["identity"]["daemon_id"] == \
+        doc["identity"]["daemon_id"]

@@ -588,7 +588,9 @@ def test_daemon_forensics_e2e(dataset, serve_tmp, golden):
         # --- inspect --socket: rendered timeline -------------------
         run = _inspect(["--socket", sock, "--job", str(jid)])
         assert run.returncode == 0, run.stderr
-        assert f"job {jid} (tenantA)" in run.stdout
+        # r15: the header carries the job's trace id (here the
+        # daemon-minted <pid>-<job> one — no wire context was sent)
+        assert f"job {jid} (tenantA, trace " in run.stdout
         assert "queue wait" in run.stdout
         assert "fused_dispatch" in run.stdout
         assert "occupancy=" in run.stdout
@@ -631,7 +633,7 @@ def test_daemon_forensics_e2e(dataset, serve_tmp, golden):
         # --- inspect --dump: post-mortem render --------------------
         run = _inspect(["--dump", dump, "--job", str(jid2)])
         assert run.returncode == 0, run.stderr
-        assert f"job {jid2} (tenantB)" in run.stdout
+        assert f"job {jid2} (tenantB, trace " in run.stdout
         assert "admit" in run.stdout and "queue wait" in run.stdout
         run = _inspect(["--dump", dump])
         assert run.returncode == 0, run.stderr
