@@ -233,11 +233,87 @@ def _cpu_leg_due(prefix) -> bool:
     return True
 
 
+def _simulated_fallback():
+    """Bench record from a deterministic simulated workload, for
+    hosts without the golden sample dataset (r16).  Walls and
+    distances from simulated reads are NOT comparable to the
+    golden-sample trajectory, so every gated value ships with a
+    ``*_provenance`` marker and quality lands under ``sim_*`` names —
+    the gate skips provenance-marked values on both the fresh side
+    (check()) and the reference side (reference_value()), so this
+    record clears trajectory staleness and carries a live calhealth
+    block without ever serving as a performance reference."""
+    import tempfile
+
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.ops import cpu
+    from racon_tpu.tools import simulate
+
+    log(f"[bench] golden sample dataset missing ({DATA}); running "
+        "the deterministic simulated fallback workload")
+    with tempfile.TemporaryDirectory(prefix="racon_bench_sim_") as tmp:
+        # read_len caps the align-bucket dim (the ONT lognormal tail
+        # reaches 4x read_len): 1.5 kb keeps the largest bucket at
+        # 8192, so the fallback stays affordable on a CPU backend
+        sim = dict(genome_len=40_000, coverage=8, read_len=1_500,
+                   seed=7, ont=True)
+        reads, paf, draft = simulate.simulate(tmp, **sim)
+        dataset = (f"simulated:{sim['genome_len'] // 1000}kb_"
+                   f"{sim['coverage']}x_ont")
+        truth = open(os.path.join(tmp, "genome.fasta"),
+                     "rb").read().split(b"\n")[1]
+
+        def run(poa, al):
+            pol = create_polisher(
+                reads, paf, draft, PolisherType.kC, 500, 10.0, 0.3,
+                True, 5, -4, -8, num_threads=8, tpu_poa_batches=poa,
+                tpu_aligner_batches=al)
+            t0 = time.monotonic()
+            pol.initialize()
+            out = pol.polish(True)
+            return time.monotonic() - t0, out, pol
+
+        cpu_wall, cpu_out, _ = run(0, 0)
+        cold_wall, _, _ = run(1, 1)      # compiles + calibration gen-1
+        run(1, 1)                        # settle/freeze
+        accel_wall, accel_out, pol = run(1, 1)
+        w2, out2, _ = run(1, 1)
+        deterministic = (len(accel_out) == len(out2) and all(
+            a.data == b.data for a, b in zip(accel_out, out2)))
+        accel_wall = min(accel_wall, w2)
+        d_tpu = cpu.edit_distance(accel_out[0].data, truth)
+        d_cpu = cpu.edit_distance(cpu_out[0].data, truth)
+        m = pol.metrics
+        from racon_tpu.obs import calhealth
+        prov = "simulated dataset (golden sample unavailable)"
+        record = {
+            "metric": "sample_e2e_polish_wall_s",
+            "value": round(accel_wall, 3), "unit": "s",
+            "vs_baseline": round(cpu_wall / accel_wall, 3),
+            "value_provenance": prov,
+            "dataset": dataset,
+            "cpu_wall_s": round(cpu_wall, 3),
+            "cpu_wall_provenance": prov,
+            "cold_wall_s": round(cold_wall, 3),
+            "deterministic": deterministic,
+            "sim_edit_distance": int(d_tpu),
+            "sim_cpu_edit_distance": int(d_cpu),
+            "align_stage_s": round(
+                m.value("stage_wall_s.device_align", 0.0), 3),
+            "poa_stage_s": round(
+                m.value("stage_wall_s.device_poa", 0.0), 3),
+            "calhealth": calhealth.summary(m.snapshot()),
+        }
+        log(f"[bench] simulated fallback: CPU {cpu_wall:.1f}s "
+            f"(dist {d_cpu}), TPU {accel_wall:.1f}s warm / "
+            f"{cold_wall:.1f}s cold (dist {d_tpu}), "
+            f"deterministic {deterministic}")
+        print(json.dumps(record))
+
+
 def main():
     if not os.path.isdir(DATA):
-        print(json.dumps({"metric": "sample_e2e_polish_wall_s",
-                          "value": -1.0, "unit": "s", "vs_baseline": 0.0,
-                          "error": "sample dataset not available"}))
+        _simulated_fallback()
         return
 
     # build-time kernel compilation (the install-step analog -- the
@@ -409,6 +485,11 @@ def main():
                 m.value("ledger_ready_high_water")),
             "poa_split_detail": getattr(pol, "poa_split_detail", {}),
         }
+        # r16 calibration health: per-stage predicted-vs-actual drift
+        # from the warm run's registry — the bench gate warns (non-
+        # fatally) when any stage's EWMA leaves the advisory band
+        from racon_tpu.obs import calhealth
+        extra["calhealth"] = calhealth.summary(m.snapshot())
         tpu_ok = True
     except Exception as exc:  # TPU path unavailable -> report CPU path
         log(f"[bench] TPU path unavailable ({type(exc).__name__}: {exc})")

@@ -116,9 +116,16 @@ def load_trajectory(directory: str) -> list:
 
 
 def reference_value(trajectory: list, key: str):
-    """Median of the newest <=3 records carrying ``key``."""
+    """Median of the newest <=3 records carrying ``key`` as a real
+    measurement.  Provenance-marked values (carried forward or from a
+    simulated-dataset fallback, bench.py r16) are re-shipped or
+    incomparable numbers, not fresh references — skipped here for the
+    same reason check() skips them on the fresh side."""
+    prov_key = (key[:-2] if key.endswith("_s") else key) \
+        + "_provenance"
     vals = [rec[key] for _, rec in trajectory
-            if isinstance(rec.get(key), (int, float))][-3:]
+            if isinstance(rec.get(key), (int, float))
+            and not rec.get(prov_key)][-3:]
     if not vals:
         return None
     vals = sorted(float(v) for v in vals)
@@ -244,6 +251,31 @@ def staleness_warning(directory: str):
             f"(see README 'Bench regression gate')")
 
 
+def drift_warnings(fresh: dict) -> list:
+    """Advisory calibration-drift warnings from the fresh record's
+    ``calhealth`` block (bench.py r16): one message per stage whose
+    measured/predicted EWMA sits outside the advisory band.  A record
+    without the block (older bench, CPU-only path) warns nothing."""
+    cal = fresh.get("calhealth") or {}
+    stages = cal.get("stages") or {}
+    band = cal.get("band") or (0.5, 2.0)
+    lo, hi = float(band[0]), float(band[1])
+    out = []
+    for name in sorted(stages):
+        s = stages[name] or {}
+        ew = s.get("ewma")
+        if not s.get("n") or ew is None:
+            continue
+        if ew < lo or ew > hi:
+            out.append(
+                f"stage {name}: measured/predicted wall EWMA "
+                f"{ew:.2f} outside [{lo:.2f}, {hi:.2f}] over "
+                f"{s['n']} sample(s) — the calibration rates price "
+                f"this stage badly; re-run with "
+                f"RACON_TPU_RECALIBRATE=1 (advisory, not a failure)")
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Gate a fresh bench JSON against the committed "
@@ -290,6 +322,12 @@ def main(argv=None) -> int:
         # advisory only: a stale reference makes the gate LENIENT
         # (old, slower numbers), so warn loudly but never fail on it
         print(f"[bench_gate] STALE-TRAJECTORY WARNING: {stale}",
+              file=sys.stderr)
+    for warning in drift_warnings(fresh):
+        # advisory only (r16): calibration drift means the admission
+        # and split models price work badly, not that the code is
+        # slower — surface it next to the gate, never fail on it
+        print(f"[bench_gate] DRIFT WARNING: {warning}",
               file=sys.stderr)
     print(format_table(rows), file=sys.stderr)
     failed = [r for r in rows if r["fail"]]

@@ -1,0 +1,100 @@
+#!/usr/bin/env bash
+# Decision-plane tier-1 (ISSUE 12 / r16 CI satellite): decision
+# records are telemetry ONLY, so this lane proves the posture the
+# explain story depends on:
+#
+#   1. the FULL tier-1 suite with decision recording pinned on and a
+#      ring small enough to wrap constantly (so eviction runs on
+#      every code path), under PYTHONDEVMODE=1 -- any byte break,
+#      resource leak or hot-path surprise from always-on decision
+#      recording fails the whole suite, including every
+#      byte-identity golden;
+#   2. explain smoke vs a LIVE daemon: serve a job with decisions
+#      on, then `racon-tpu explain --socket [--job N]` must render
+#      the per-job cost waterfall (predicted vs measured) and the
+#      calibration-health drift table from the daemon's explain op.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export RACON_TPU_DECISIONS=1
+export RACON_TPU_DECISIONS_RING=64
+export PYTHONDEVMODE=1
+python -m pytest tests/ -q -m "not slow" \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"
+
+echo "[decision_tier1] explain-CLI smoke vs a live daemon"
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+JAX_PLATFORMS=cpu \
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+RACON_TPU_CLI_PREWARM=0 \
+RACON_TPU_CACHE_DIR="$work/cache" \
+python - "$work" <<'EOF'
+import os
+import socket
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.getcwd())
+from racon_tpu.serve import client
+from racon_tpu.tools import simulate
+
+work = sys.argv[1]
+reads, paf, draft = simulate.simulate(
+    os.path.join(work, "data"), genome_len=8_000, coverage=5,
+    read_len=800, seed=33, ont=True)
+sock = os.path.join(work, "d.sock")
+log = open(os.path.join(work, "serve.log"), "wb")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "racon_tpu.cli", "serve",
+     "--socket", sock],
+    stdout=log, stderr=log, env=dict(os.environ))
+try:
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                "server died: " + open(log.name).read())
+        if os.path.exists(sock):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock)
+            except OSError:
+                pass
+            else:
+                break
+            finally:
+                probe.close()
+        time.sleep(0.2)
+    else:
+        raise AssertionError("server socket never came up")
+
+    resp = client.submit(sock, {
+        "sequences": reads, "overlaps": paf, "targets": draft,
+        "threads": 4, "tpu_poa_batches": 1,
+        "tpu_aligner_batches": 1})
+    assert resp["ok"], resp
+    jid = resp["job_id"]
+
+    def explain(*args):
+        run = subprocess.run(
+            [sys.executable, "-m", "racon_tpu.cli", "explain",
+             "--socket", sock, *args],
+            capture_output=True, text=True, timeout=120)
+        assert run.returncode == 0, run.stderr
+        return run.stdout
+
+    out = explain("--job", str(jid))
+    assert f"job {jid} " in out, out
+    assert "predicted" in out and "measured" in out, out
+    assert "calibration health" in out, out
+    out = explain()
+    assert "decision ring @ pid" in out, out
+    print("[decision_tier1] explain smoke ok (job", jid, ")")
+finally:
+    if proc.poll() is None:
+        proc.kill()
+    log.close()
+EOF
+echo "[decision_tier1] done"

@@ -32,6 +32,7 @@ USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequenc
        racon-tpu top (--socket PATH | --fleet S1,S2,..) [--interval S] [--once] [--json]
        racon-tpu metrics (--socket PATH | --fleet S1,S2,..) [--json|--prometheus]
        racon-tpu inspect (--socket PATH | --dump FILE) [--job N] [--json]
+       racon-tpu explain (--socket PATH | --metrics-json FILE) [--job N] [--json]
 
     subcommands (racon_tpu/serve — persistent polishing service):
         serve    start the warm-kernel job daemon on a unix socket
@@ -52,6 +53,10 @@ USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequenc
         inspect  render a job's timeline (queue wait, exec, fused
                  dispatches with occupancy) from a live daemon's
                  flight recorder or a post-mortem flight dump
+        explain  render the decision plane: a job's cost waterfall
+                 (stage walls, decision counts) and the per-stage
+                 predicted-vs-actual calibration-health table, from
+                 a live daemon or a --metrics-json run report
 
 
     #default output is stdout
@@ -265,6 +270,9 @@ def main(argv=None):
     if argv and argv[0] == "inspect":
         from racon_tpu.serve import inspect as serve_inspect
         raise SystemExit(serve_inspect.main(argv[1:]))
+    if argv and argv[0] == "explain":
+        from racon_tpu.serve import explain as serve_explain
+        raise SystemExit(serve_explain.main(argv[1:]))
     try:
         opts, inputs = parse_args(argv)
     except ValueError as exc:

@@ -22,6 +22,7 @@ from racon_tpu.core.window import Window, WindowType
 from racon_tpu.io.parsers import (create_overlap_parser,
                                   create_sequence_parser)
 from racon_tpu.obs import REGISTRY, Registry
+from racon_tpu.obs import calhealth as obs_calhealth
 from racon_tpu.obs import trace as obs_trace
 from racon_tpu.ops import cpu
 from racon_tpu.utils.logger import Logger
@@ -565,6 +566,19 @@ class Polisher:
                      for k in ("host.parse_s", "host.bp_decode_s",
                                "host.fragment_s", "host.stitch_s"))
         self.metrics.set("host.stage_s", round(host_s, 6))
+        # calibration health (r16): host stages have no calibrate
+        # rate, so drift is measured against the stage's own learned
+        # per-unit rate (racon_tpu/obs/calhealth.observe_units) —
+        # unit counts are the natural stage denominators
+        units = {"host.parse": len(self.sequences),
+                 "host.bp_decode": len(self.sequences),
+                 "host.fragment": len(self.windows),
+                 "host.stitch": self._targets_size}
+        for stage, n in units.items():
+            wall = float(self.metrics.value(stage + "_s", 0.0))
+            if wall > 0:
+                obs_calhealth.observe_units(stage, max(1, n), wall,
+                                            registry=self.metrics)
         wall = obs_trace.now() - getattr(self, "_t_run_start",
                                          obs_trace.now())
         if wall > 0:

@@ -37,6 +37,14 @@ dispatch) whose timing story needs first-class tooling:
   structured events (admits, rejects, fused dispatches, errors with
   tracebacks), dumped on crash/drain and readable live over the
   serve socket — crash forensics for the daemon.
+* :mod:`racon_tpu.obs.decision` — the decision-record plane (r16):
+  a bounded exemplar ring of placement decisions (align ladder path,
+  POA split/speculation, shelf variant contacts) tagged with job
+  context, behind the ``explain`` op and ``racon-tpu explain``.
+* :mod:`racon_tpu.obs.calhealth` — per-stage predicted-vs-actual
+  drift ratios (EWMA + p50/p99 in the registry) with advisory
+  recalibration flags — the calibration-health model the explain
+  waterfall, ``top`` drift column and bench-gate DRIFT warning read.
 
 Determinism contract: clocks here feed ONLY the trace and the
 metrics, never control flow — a tracing-enabled run emits
@@ -51,8 +59,10 @@ ci/cpu/obs_tier1.sh and tests/test_obs.py fails on raw
 from __future__ import annotations
 
 from racon_tpu.obs.aggregate import merge_histograms, merge_snapshots
+from racon_tpu.obs.calhealth import DRIFT_BAND
 from racon_tpu.obs.context import (JobContext, current, job_context,
                                    jobs_for_tenant, valid_trace_id)
+from racon_tpu.obs.decision import DECISIONS, DecisionRecorder
 from racon_tpu.obs.devutil import DEVICE_UTIL, DeviceUtil
 from racon_tpu.obs.flight import FLIGHT, FlightRecorder
 from racon_tpu.obs.metrics import (HIST_BUCKETS, REGISTRY, MetricAttr,
@@ -66,5 +76,6 @@ __all__ = [
     "now", "span", "device_span", "enable_trace", "write_trace",
     "JobContext", "job_context", "current", "jobs_for_tenant",
     "valid_trace_id", "FLIGHT", "FlightRecorder",
+    "DECISIONS", "DecisionRecorder", "DRIFT_BAND",
     "merge_histograms", "merge_snapshots",
 ]

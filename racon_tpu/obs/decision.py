@@ -1,0 +1,161 @@
+"""Decision-record plane: WHY each unit of work ran where it did.
+
+The serve tier is observable from the outside (metrics, traces, the
+flight recorder), but the layer that actually *decides* placement —
+the align rung ladder, the POA device/CPU split model, speculative
+adoption, AOT-shelf variant selection — was a black box:
+``serve_wall_err_ratio`` says *that* the cost model drifted, never
+*which* decision paid for it.  This module records every such
+decision as a cheap structured event in a bounded exemplar ring
+(same pattern as racon_tpu/obs/flight.py), tagged with the active
+job context so ``racon-tpu explain --job N`` can replay one job's
+ladder path after the fact.
+
+Event kinds written by the pipeline (all fields optional beyond the
+envelope; see the call sites):
+
+* ``align_probe``   — probe divergence outcome (p50/p75 ratios)
+* ``align_chunk``   — one ladder dispatch: engine (wfa/band), rung,
+  units, predicted vs measured wall
+* ``align_retry``   — a rung overflowed and pairs moved up-ladder
+* ``align_cpu_fallthrough`` — pairs that fell off the ladder to CPU
+* ``poa_split``     — the rate-model device/CPU cut and the rates
+  (with provenance) it was priced with
+* ``poa_spec``      — speculative-adoption outcome (used/wasted,
+  CPU-recompute fallbacks)
+* ``poa_chunk``     — one device POA dispatch: units, predicted vs
+  measured wall
+* ``poa_reject``    — per-window engine reject codes
+* ``shelf``         — AOT-shelf variant hit/miss/fallback
+* ``job_stages``    — per-job stage-wall rollup (serve sessions)
+* ``unit_retry``    — executor poisoned-unit fallback (also mirrored
+  into the flight ring for ``inspect`` timelines)
+
+The envelope matches the flight recorder's::
+
+    {"seq": 91, "t": 3.20154, "kind": "align_chunk",
+     "job": 4, "tenant": "a", ...kind-specific fields}
+
+Knobs (registered in provenance.KNOWN_KNOBS):
+
+* ``RACON_TPU_DECISIONS``      — "0" disables recording (default on)
+* ``RACON_TPU_DECISIONS_RING`` — ring capacity (default 2048)
+
+Determinism: decision records feed ONLY observability, never control
+flow — a decisions-on run emits byte-identical polish output to a
+decisions-off run (pinned in tests/test_decision.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+
+from racon_tpu.obs import context as _context
+from racon_tpu.obs import trace as _trace
+
+SCHEMA = "racon-tpu-decisions-v1"
+
+_DEF_RING = 2048
+
+
+def enabled() -> bool:
+    return os.environ.get("RACON_TPU_DECISIONS", "1") != "0"
+
+
+def ring_size() -> int:
+    try:
+        n = int(os.environ.get("RACON_TPU_DECISIONS_RING", "")
+                or _DEF_RING)
+    except ValueError:
+        n = _DEF_RING
+    return max(16, n)
+
+
+class DecisionRecorder:
+    """Bounded ring of placement-decision events.  Thread-safe;
+    :meth:`record` is the hot path and does one deque append under
+    the lock (numbers are pre-rounded by the call sites)."""
+
+    def __init__(self, maxlen: int = None):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=maxlen or ring_size())
+        self._seq = 0
+        self._dropped = 0
+
+    # -- recording -----------------------------------------------------
+
+    def record(self, kind: str, job=None, tenant=None,
+               **fields) -> None:
+        """Append one decision event.  ``job``/``tenant``/``trace_id``
+        default from the active job context so pipeline call sites
+        need no plumbing; ``None`` fields are dropped."""
+        if not enabled():
+            return
+        ctx = _context.current()
+        if ctx is not None:
+            if job is None:
+                job = ctx.job_id
+            if tenant is None:
+                tenant = ctx.tenant
+            if fields.get("trace_id") is None:
+                fields["trace_id"] = ctx.trace_id
+        ev = {"kind": kind, "t": round(
+            _trace.epoch_offset(_trace.now()), 6)}
+        if job is not None:
+            ev["job"] = int(job)
+        if tenant is not None:
+            ev["tenant"] = str(tenant)
+        for k, v in fields.items():
+            if v is not None:
+                ev[k] = v
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+            self._ring.append(ev)
+
+    # -- reading -------------------------------------------------------
+
+    def snapshot(self, job=None, kind=None, last: int = 0) -> list:
+        """Copies of ring events, oldest first.  ``job`` filters to
+        events tagged with (or spanning, via a ``jobs`` list) that
+        job, ``kind`` to one event kind, ``last`` keeps the newest N
+        after filtering."""
+        with self._lock:
+            evs = [dict(ev) for ev in self._ring]
+        if job is not None:
+            job = int(job)
+            evs = [ev for ev in evs
+                   if ev.get("job") == job
+                   or job in ev.get("jobs", ())]
+        if kind is not None:
+            evs = [ev for ev in evs if ev.get("kind") == kind]
+        if last and last > 0:
+            evs = evs[-last:]
+        return evs
+
+    def counts(self, job=None) -> dict:
+        """``{kind: count}`` over the (optionally job-filtered) ring —
+        the cheap summary the ``explain`` waterfall leads with."""
+        out: dict = {}
+        for ev in self.snapshot(job=job):
+            out[ev["kind"]] = out.get(ev["kind"], 0) + 1
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"enabled": enabled(), "size": len(self._ring),
+                    "capacity": self._ring.maxlen,
+                    "recorded": self._seq, "dropped": self._dropped}
+
+
+DECISIONS = DecisionRecorder()
+
+
+def _reset_for_tests() -> None:
+    """Fresh singleton (re-reads RACON_TPU_DECISIONS_RING)."""
+    global DECISIONS
+    DECISIONS = DecisionRecorder()

@@ -273,6 +273,16 @@ def json_snapshot(snapshot: dict) -> dict:
     }
 
 
+def drift_summary(snapshot: dict) -> dict:
+    """Per-stage calibration-health document for any snapshot form
+    (plain, json_snapshot, or fleet-merged) — delegates to
+    :func:`racon_tpu.obs.calhealth.summary` so the export surface and
+    the ``explain`` op serve the identical shape."""
+    from racon_tpu.obs import calhealth
+
+    return calhealth.summary(snapshot)
+
+
 def slo_summary(snapshot: dict, prefix: str = "serve_") -> dict:
     """Percentile summary of every histogram under ``prefix`` — the
     serving-tier SLO view (queue_wait/exec_wall/e2e_wall/wall error)

@@ -165,6 +165,17 @@ class FlightRecorder:
         doc = {"schema": SCHEMA, "pid": os.getpid(),
                "reason": reason, "ring": self.stats(),
                "events": self.snapshot()}
+        try:
+            # ride the decision-record ring along (r16): a post-mortem
+            # dump then carries the ladder/split exemplars that led up
+            # to the crash, not just the serve-plane events
+            from racon_tpu.obs import decision as _decision
+
+            doc["decisions"] = {"ring": _decision.DECISIONS.stats(),
+                                "events":
+                                    _decision.DECISIONS.snapshot()}
+        except Exception:
+            pass
         tmp = path + f".tmp{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(doc, f, indent=1)

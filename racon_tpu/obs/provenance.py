@@ -89,6 +89,12 @@ KNOWN_KNOBS = {
     "RACON_TPU_FLEET_INTERVAL_S": "1.0",
     "RACON_TPU_FLEET_TIMEOUT_S": "5.0",
     "RACON_TPU_FLEET_STALE_S": "10.0",
+    # decision plane (r16, racon_tpu/obs/decision.py + calhealth.py):
+    # per-unit decision-record off-switch and exemplar-ring capacity
+    # (telemetry only — `racon-tpu explain` and the drift tables read
+    # it, control flow never does)
+    "RACON_TPU_DECISIONS": "1",
+    "RACON_TPU_DECISIONS_RING": "2048",
 }
 
 # host-capability probe reference wall (bench.py's budget scaling):

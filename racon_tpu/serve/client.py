@@ -110,6 +110,20 @@ def flight(socket_path: str, job=None, last: int = 0,
     return request(socket_path, frame, timeout=timeout)
 
 
+def explain(socket_path: str, job=None, last: int = 0,
+            timeout: float = 30.0) -> dict:
+    """Decision-plane view (the ``explain`` op): per-stage
+    calibration health (``calhealth``), decision-ring stats, per-kind
+    counts and the decision events — optionally filtered to one
+    ``job`` or the newest ``last`` events."""
+    frame = {"op": "explain"}
+    if job is not None:
+        frame["job"] = int(job)
+    if last:
+        frame["last"] = int(last)
+    return request(socket_path, frame, timeout=timeout)
+
+
 def watch(socket_path: str, interval_s: float = 1.0, count: int = 0,
           timeout: float = None):
     """Generator over streamed telemetry frames (the ``watch`` op).

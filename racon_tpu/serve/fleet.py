@@ -234,6 +234,10 @@ def merge_fleet(rows) -> dict:
         "daemons": daemons,
         "merged": merged,
         "slo": export.slo_summary(merged),
+        # r16: fleet calibration health over the exact merge — the
+        # per-stage quantiles come from the union of every daemon's
+        # drift-ratio histogram, the EWMA is the per-daemon mean
+        "calhealth": export.drift_summary(merged),
     }
 
 

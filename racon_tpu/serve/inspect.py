@@ -70,6 +70,11 @@ def _detail(ev: dict) -> str:
                 f"items={ev.get('items', '?')} "
                 f"occupancy={ev.get('occupancy', '?')} "
                 f"tenants={','.join(ev.get('tenants', []))}")
+    if kind == "unit_retry":
+        return (f"{ev.get('unit_kind', '?')} "
+                f"tenant={ev.get('tenant', 'default')} "
+                f"items={ev.get('items', '?')} "
+                f"error={ev.get('error', '?')}")
     if kind in ("error", "crash"):
         err = str(ev.get("error", "")).splitlines()
         return err[0] if err else ""

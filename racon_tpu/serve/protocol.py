@@ -8,7 +8,8 @@ Every message in both directions is one frame::
 
 The JSON document is always an object.  Client requests carry an
 ``op`` key (``submit`` / ``status`` / ``pause`` / ``resume`` /
-``shutdown`` / ``metrics`` / ``health`` / ``watch`` / ``flight``);
+``shutdown`` / ``metrics`` / ``health`` / ``watch`` / ``flight`` /
+``explain``);
 server responses carry ``ok`` (bool) and, when ``ok`` is false, a
 machine-readable ``error`` object::
 
@@ -79,6 +80,19 @@ Fleet ops (r15, racon_tpu/serve/fleet.py):
   ``start_epoch``/``version``/``backend``) so a fleet scraper
   attributes every frame to a PROCESS, not a socket path that may
   be reused across restarts.
+
+Decision-plane ops (r16, racon_tpu/obs/decision.py + calhealth.py):
+
+* ``explain`` — the decision-record view: per-stage calibration
+  health (``calhealth`` — predicted/actual drift EWMA + p50/p99 per
+  stage with advisory recalibration flags), decision-ring stats
+  (``ring``), per-kind event counts (``counts``) and the structured
+  decision events themselves (``events``), optionally filtered with
+  ``job: <id>`` and/or ``last: <n>`` exactly like ``flight``.  The
+  ``racon-tpu explain`` CLI renders a per-job cost waterfall from
+  this one frame.
+* ``metrics`` / ``watch`` frames also carry the ``calhealth``
+  summary, so the ``top`` drift column needs no extra round trip.
 """
 
 from __future__ import annotations

@@ -47,6 +47,7 @@ from typing import Callable, Optional
 
 from racon_tpu.obs import REGISTRY
 from racon_tpu.obs import context as obs_context
+from racon_tpu.obs import decision as obs_decision
 from racon_tpu.obs import flight as obs_flight
 from racon_tpu.obs import trace as obs_trace
 
@@ -358,6 +359,16 @@ class JobScheduler:
             if predicted and predicted > 0:
                 REGISTRY.observe("serve_wall_err_ratio",
                                  exec_wall / predicted)
+                # decision-plane twin (r16): the job-level admission
+                # drift as an exemplar, so `explain --job N` shows the
+                # headline predicted-vs-actual next to the per-stage
+                # attribution
+                obs_decision.DECISIONS.record(
+                    "job_wall", job=job.id, tenant=job.tenant,
+                    trace_id=job.trace_id,
+                    predicted_s=round(float(predicted), 6),
+                    measured_s=round(exec_wall, 6),
+                    ratio=round(exec_wall / predicted, 6))
             with self._cond:
                 del self._running[job.id]
                 self._completed += 1

@@ -52,6 +52,7 @@ import threading
 
 from racon_tpu.obs import REGISTRY
 from racon_tpu.obs import context as obs_context
+from racon_tpu.obs import decision as obs_decision
 from racon_tpu.obs import flight as obs_flight
 from racon_tpu.obs import trace as obs_trace
 from racon_tpu.serve import protocol
@@ -191,6 +192,7 @@ class PolishServer:
             "device_util": du,
             "fusion": device_executor.get_executor().stats(),
             "slo": export.slo_summary(snap),
+            "calhealth": export.drift_summary(snap),
             "snapshot": export.json_snapshot(snap),
         }
         if prometheus:
@@ -219,6 +221,33 @@ class PolishServer:
         if job is not None:
             doc["job_trace"] = obs_trace.TRACER.job_slice(job)
         return doc
+
+    def _explain_doc(self, req: dict) -> dict:
+        """The decision-plane view (``explain`` op, r16):
+        per-stage calibration health plus the decision-record ring —
+        optionally filtered to one job (``job``) or the newest N
+        events (``last``).  The client CLI renders the per-job cost
+        waterfall from this one frame."""
+        from racon_tpu.obs import export
+
+        try:
+            job = req.get("job")
+            job = int(job) if job is not None else None
+            last = int(req.get("last", 0) or 0)
+        except (TypeError, ValueError):
+            return protocol.error_frame(
+                "bad_request", "explain: job/last must be integers")
+        snap = REGISTRY.snapshot()
+        return {
+            "ok": True,
+            "pid": os.getpid(),
+            "identity": self._identity(),
+            "calhealth": export.drift_summary(snap),
+            "ring": obs_decision.DECISIONS.stats(),
+            "counts": obs_decision.DECISIONS.counts(job=job),
+            "events": obs_decision.DECISIONS.snapshot(job=job,
+                                                      last=last),
+        }
 
     def _health_doc(self) -> dict:
         """Liveness/readiness without a registry walk — cheap enough
@@ -311,6 +340,8 @@ class PolishServer:
                 resp = self._health_doc()
             elif op == "flight":
                 resp = self._flight_doc(req)
+            elif op == "explain":
+                resp = self._explain_doc(req)
             elif op == "pause":
                 self.scheduler.pause()
                 resp = {"ok": True, "paused": True}

@@ -34,6 +34,7 @@ import base64
 
 from racon_tpu import obs
 from racon_tpu.obs import REGISTRY
+from racon_tpu.obs import decision as obs_decision
 from racon_tpu.obs import flight as obs_flight
 from racon_tpu.obs import trace as obs_trace
 
@@ -113,6 +114,16 @@ def run_job(job) -> dict:
 
     wall = obs.now() - t0
     m = polisher.metrics
+    # decision-plane rollup (r16): one job-tagged event carrying the
+    # job's stage walls so `racon-tpu explain --job N` can render the
+    # cost waterfall straight from the decision ring (the worker runs
+    # under the job context, so job/tenant/trace tags are automatic)
+    obs_decision.DECISIONS.record(
+        "job_stages", wall_s=round(wall, 6),
+        stage_walls={k: round(v, 6) for k, v in
+                     getattr(polisher, "stage_walls", {}).items()},
+        split_mode=getattr(polisher, "poa_split_detail",
+                           {}).get("mode"))
     # per-job namespaced process counters: local writes only, so the
     # process totals (and every other job's registry) stay untouched
     for name in _PROCESS_COUNTERS:

@@ -84,17 +84,35 @@ def render(doc: dict) -> str:
                 f"{row.get('running', 0):>7d}  {waits}")
 
     du = doc.get("device_util") or {}
+    # r16: the calibration-health EWMA rides every telemetry frame
+    # (doc["calhealth"]); engine names ARE calhealth stage names, so
+    # the drift ratio (measured/predicted) lands next to each
+    # engine's utilization — "!" marks a stage outside the band
+    cal = (doc.get("calhealth") or {}).get("stages") or {}
+
+    def _drift(stage: str) -> str:
+        s = cal.get(stage) or {}
+        if not s.get("n") or s.get("ewma") is None:
+            return "-"
+        return f"{s['ewma']:.2f}" + ("!" if s.get("drift") else "")
+
     if du:
         lines.append("")
         lines.append("engine       util  busy      idle      "
-                     "dispatches")
+                     "dispatches  drift")
         for eng in sorted(du):
             e = du[eng]
             lines.append(
                 f"{eng:<12s} {e['util'] * 100:4.0f}%  "
                 f"{_fmt_s(e['busy_s']):<8s}  "
                 f"{_fmt_s(e['idle_s']):<8s}  "
-                f"{e['n_dispatches']}")
+                f"{e['n_dispatches']!s:<10s}  "
+                f"{_drift(eng)}")
+        host = sorted(k for k in cal
+                      if k.startswith("host.") and cal[k].get("n"))
+        for stage in host:
+            lines.append(f"{stage:<12s}    -  {'-':<8s}  {'-':<8s}  "
+                         f"{'-':<10s}  {_drift(stage)}")
 
     slo = doc.get("slo") or {}
     if slo:
@@ -159,6 +177,23 @@ def render_fleet(doc: dict) -> str:
                 f"{name:<22s} {s['count']:>5d}   "
                 f"{_fmt_s(s['p50']):<8s}  {_fmt_s(s['p90']):<8s}  "
                 f"{_fmt_s(s['p99']):<8s}")
+
+    # r16: fleet-wide calibration health from the exactly-merged
+    # snapshot union (racon_tpu/serve/fleet.py merge_fleet)
+    cal = (doc.get("calhealth") or {}).get("stages") or {}
+    rows = {k: v for k, v in cal.items() if v.get("n")}
+    if rows:
+        lines.append("")
+        lines.append("fleet drift            n      ewma     p50     "
+                     " p99")
+        for name in sorted(rows):
+            s = rows[name]
+            ew = s.get("ewma")
+            lines.append(
+                f"{name:<22s} {s['n']:>4d}   "
+                f"{'-' if ew is None else format(ew, '6.2f'):>6s}  "
+                f"{s.get('p50', 0.0):>6.2f}  {s.get('p99', 0.0):>6.2f}"
+                + ("   DRIFT" if s.get("drift") else ""))
     return "\n".join(lines) + "\n"
 
 

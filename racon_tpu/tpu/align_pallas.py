@@ -58,6 +58,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from racon_tpu.obs import decision as _decision
 from racon_tpu.obs import devutil as obs_devutil
 from racon_tpu.obs import trace as obs_trace
 
@@ -723,6 +724,11 @@ def align_dispatch(queries, targets, lq: int, lt: int, wb: int,
                 f"device.align_band{wb}", t_disp, t_end, cat="device",
                 lane="device", args={"n": n_real})
             obs_devutil.DEVICE_UTIL.record("align_band", t_disp, t_end)
+            # decision-plane exemplar (r16): the pure device interval
+            # for this dispatch, free of host packing/decode time
+            _decision.DECISIONS.record(
+                "align_device", engine="band", rung=int(wb),
+                n=int(n_real), device_s=round(t_end - t_disp, 6))
         except Exception:
             pass  # dispatch errors surface at collect()
 
@@ -1216,6 +1222,9 @@ def wfa_dispatch(queries, targets, lq: int, emax: int, mesh=None):
                 f"device.align_wfa{emax}", t_disp, t_end,
                 cat="device", lane="device", args={"n": n_real})
             obs_devutil.DEVICE_UTIL.record("align_wfa", t_disp, t_end)
+            _decision.DECISIONS.record(
+                "align_device", engine="wfa", rung=int(emax),
+                n=int(n_real), device_s=round(t_end - t_disp, 6))
         except Exception:
             pass  # dispatch errors surface at collect()
 

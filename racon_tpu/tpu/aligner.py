@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from racon_tpu.obs import decision as obs_decision
 from racon_tpu.utils.tuning import scan_unroll as _unroll
 
 # base encoding: A/C/G/T -> 0..3, anything else 4; pads never match
@@ -366,6 +367,14 @@ def band_align_batch(queries: Sequence[bytes], targets: Sequence[bytes],
         ok = cost <= hw
         ops_out[idx[ok]] = ops[ok]
         pending = np.setdiff1d(pending, idx[ok], assume_unique=True)
+        # ladder-path exemplar (r16): pairs whose measured tape cost
+        # broke this rung's certificate re-run wider — telemetry
+        # only, the retry itself is unchanged
+        n_retry = int(len(idx) - int(ok.sum()))
+        if n_retry:
+            obs_decision.DECISIONS.record(
+                "align_retry", engine="band", rung=int(hw),
+                pairs=n_retry)
     # past the ladder, the unbanded kernel is exact for everything; it
     # is only prohibitive on the largest buckets, where callers with
     # allow_full=False route the (rare) ultra-divergent pairs to the
@@ -379,6 +388,9 @@ def band_align_batch(queries: Sequence[bytes], targets: Sequence[bytes],
         # run() self-chunks by the full kernel's tape footprint
         ops_out[pending] = run(pending, 0)
         pending = pending[:0]
+    if len(pending):
+        obs_decision.DECISIONS.record("align_cpu_fallthrough",
+                                      pairs=int(len(pending)))
     return ops_out, cells, pending
 
 

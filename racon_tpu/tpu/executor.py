@@ -500,7 +500,24 @@ class DeviceExecutor:
         unit.done.wait()
         try:
             return unit.fused.result(), False
-        except _FusedBatchError:
+        except _FusedBatchError as exc:
+            # crash containment made visible (r16): the poisoned-unit
+            # fallback used to be a bare counter; the flight ring now
+            # carries WHICH unit stood alone and why, so `inspect`
+            # timelines show the retry next to the fused dispatch
+            # that failed
+            cause = exc.cause if exc.cause is not None else exc
+            obs_flight.FLIGHT.record(
+                "unit_retry", unit_kind=unit.kind,
+                tenant=unit.tenant, items=unit.size,
+                jobs=sorted(unit.jobs) or None,
+                error=type(cause).__name__)
+            from racon_tpu.obs.decision import DECISIONS
+            DECISIONS.record(
+                "unit_retry", unit_kind=unit.kind,
+                tenant=unit.tenant, items=unit.size,
+                jobs=sorted(unit.jobs) or None,
+                error=type(cause).__name__)
             # shared attempt failed: this unit stands alone.  Its own
             # retry failing raises HERE -- in this unit's collect --
             # and nowhere else.

@@ -45,6 +45,7 @@ from jax import lax
 from racon_tpu.obs import devutil as obs_devutil
 from racon_tpu.obs import metrics as obs_metrics
 from racon_tpu.obs import trace as obs_trace
+from racon_tpu.obs import decision as obs_decision
 from racon_tpu.ops import cpu as cpu_ops
 from racon_tpu.utils.tuning import poa_band_cols, scan_unroll as _unroll
 
@@ -664,6 +665,8 @@ class TPUPoaBatchEngine:
                     with self._reject_lock:
                         self.reject_counts[code] = \
                             self.reject_counts.get(code, 0) + 1
+                    obs_decision.DECISIONS.record("poa_reject", code=code,
+                                     phase="extract")
                     results.append((None, False))
                     continue
                 if int(mout[b, 1]) == 2:
@@ -729,6 +732,8 @@ class TPUPoaBatchEngine:
                     with self._reject_lock:
                         self.reject_counts[rows] = \
                             self.reject_counts.get(rows, 0) + 1
+                    obs_decision.DECISIONS.record("poa_reject", code=int(rows),
+                                     phase="export")
                     return
                 nrows[i] = rows
                 s = w.sequences[li]

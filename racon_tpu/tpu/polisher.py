@@ -29,8 +29,10 @@ from racon_tpu.core.overlap import Overlap
 from racon_tpu.core.polisher import Polisher, PolisherType
 from racon_tpu.core.window import WindowType
 from racon_tpu.obs import MetricAttr
+from racon_tpu.obs import calhealth as obs_calhealth
 from racon_tpu.obs import devutil as obs_devutil
 from racon_tpu.obs import trace as obs_trace
+from racon_tpu.obs import decision as obs_decision
 
 # the one sanctioned clock (racon_tpu/obs; timestamps feed only the
 # trace/metrics/calibration records, never control flow)
@@ -807,6 +809,14 @@ class TPUPolisher(Polisher):
             "unit_p50": round(_q(sorted(units), 0.5), 2),
             "unit_p90": round(_q(sorted(units), 0.9), 2),
         }
+        # decision record (r16): the split verdict and the rates that
+        # priced it, job-tagged for `racon-tpu explain`
+        obs_decision.DECISIONS.record(
+            "poa_split", mode=self.poa_split_detail["mode"],
+            rate_dev=round(sd_dev, 4), rate_cpu=round(sd_cpu, 4),
+            source=sd_src, cut=int(dev_left),
+            n_eligible=len(eligible),
+            dev_unit_share=self.poa_split_detail["dev_unit_share"])
 
         # apply speculative consensuses: ONLY for windows this stage's
         # deterministic argmin assigns to the device (assignment never
@@ -831,6 +841,10 @@ class TPUPolisher(Polisher):
                     self.poa_device_windows += 1
             self.poa_spec_used = len(resolved)
             self.poa_spec_wasted = len(spec) - len(resolved)
+            obs_decision.DECISIONS.record(
+                "poa_spec", used=len(resolved),
+                wasted=len(spec) - len(resolved),
+                cpu_recompute=len(spec_failed) or None)
             if resolved:
                 rset = set(resolved)
                 work = deque(i for i in eligible if i not in rset)
@@ -877,9 +891,20 @@ class TPUPolisher(Polisher):
             nonlocal mark
             results = collect()
             now = _now()
+            u_batch = sum(unit_of[i] for i in idxs)
             if record:
-                meas["dev"].append((now - mark,
-                                    sum(unit_of[i] for i in idxs)))
+                meas["dev"].append((now - mark, u_batch))
+                # calibration health (r16): this megabatch's wall vs
+                # what the split-model rate predicted for it
+                pred = calibrate.predict_chunk_wall(
+                    "poa", u_batch, sd_dev, n_dev)
+                obs_calhealth.observe("poa", pred, now - mark,
+                                      registry=self.metrics)
+                obs_decision.DECISIONS.record(
+                    "poa_chunk", n=len(idxs),
+                    units=round(u_batch, 1),
+                    predicted_s=round(pred, 6),
+                    measured_s=round(now - mark, 6))
             obs_trace.TRACER.add_span(
                 "poa.megabatch", mark, now, cat="poa",
                 args={"n": len(idxs), "recorded": bool(record)})
@@ -1226,6 +1251,10 @@ class TPUPolisher(Polisher):
         probe_ratio = self._probe_divergence(pending, cpu_ops)
         ratio = min(max(probe_ratio, 0.05), 0.67)
         self.align_probe_ratio = ratio
+        obs_decision.DECISIONS.record("align_probe", n_pending=len(pending),
+                         p50=round(self.align_probe_p50, 4),
+                         p75=round(probe_ratio, 4),
+                         ratio=round(ratio, 4))
         dims = [d for d, _ in pending]
 
         def cpu_cells(d):
@@ -1259,6 +1288,10 @@ class TPUPolisher(Polisher):
             cut = _rate_split(
                 [dev_cost(i) for i in range(len(pending))],
                 [r_cpu * cpu_cells(d) / n_workers for d in dims])
+        obs_decision.DECISIONS.record(
+            "align_split", cut=int(cut), n_pending=len(pending),
+            rate_dev=round(r_dev, 4), rate_wfa=round(r_wfa, 4),
+            rate_cpu=round(r_cpu, 4), source=r_src)
 
         work = deque(pending[cut:])
         lock = threading.Lock()
@@ -1317,6 +1350,21 @@ class TPUPolisher(Polisher):
             by_rung = {}
             for eng, rung, w, units in self._align_disp:
                 by_rung.setdefault((eng, rung), []).append((w, units))
+                # calibration health (r16): this chunk's wall vs what
+                # the stage rate predicted for its unit count — the
+                # same rates the split argmin priced admission with
+                stage, rate = ("align_wfa", r_wfa) if eng == "wfa" \
+                    else ("align", r_dev)
+                pred = calibrate.predict_chunk_wall(
+                    stage, units, rate, n_dev)
+                obs_calhealth.observe(
+                    "align_wfa" if eng == "wfa" else "align_band",
+                    pred, w, registry=self.metrics)
+                obs_decision.DECISIONS.record(
+                    "align_chunk", engine=eng, rung=int(rung),
+                    units=round(units, 1),
+                    predicted_s=round(pred, 6),
+                    measured_s=round(w, 6))
             for eng, stage in (("band", "align"), ("wfa", "align_wfa")):
                 dev_w = sum(w for k, ch in by_rung.items()
                             if k[0] == eng for w, _ in ch[1:])
@@ -1360,6 +1408,9 @@ class TPUPolisher(Polisher):
                 [p[0] for p in pending],
                 float(os.environ.get("RACON_TPU_ALIGN_SPLIT",
                                      "0.5")))
+        obs_decision.DECISIONS.record(
+            "align_split", cut=int(dev_left), n_pending=len(pending),
+            source="scan")
 
         lock = threading.Lock()
         n_cpu_done = 0
@@ -1606,6 +1657,8 @@ class TPUPolisher(Polisher):
                     + len(still)
                 self.metrics.add(f"align_rung_retry.wfa{emax}",
                                  len(still))
+                obs_decision.DECISIONS.record("align_retry", engine="wfa",
+                                 rung=emax, pairs=len(still))
             self.logger.log(
                 f"[racon_tpu::TPUPolisher::align] wfa-aligned "
                 f"{n_cert}/{len(idx)} overlaps (emax {emax}"
@@ -1717,9 +1770,13 @@ class TPUPolisher(Polisher):
                 if still:
                     self.metrics.add(f"align_rung_retry.band{wb}",
                                      len(still))
+                    obs_decision.DECISIONS.record("align_retry", engine="band",
+                                     rung=wb, pairs=len(still))
             elif still:
                 self.metrics.add("align_rung_cpu_fallthrough",
                                  len(still))
+                obs_decision.DECISIONS.record("align_cpu_fallthrough",
+                                 pairs=len(still))
             tag = (f", {len(still)} "
                    + ("retries" if wb != rungs[-1] else "cpu")
                    if still else "")
@@ -1834,7 +1891,24 @@ class TPUPolisher(Polisher):
             queries, targets, blq, blt, dispatch=dispatch,
             allow_full=False, mem_budget=self.align_mem_budget,
             need_ratio=self.align_probe_p50)
-        obs_devutil.DEVICE_UTIL.record("align_band", t0, _now())
+        t1 = _now()
+        obs_devutil.DEVICE_UTIL.record("align_band", t0, t1)
+        # calibration health + decision exemplar (r16): the scan
+        # ladder prices admission with the same stored "align" rate
+        # the hybrid split uses, so its chunks score drift identically
+        from racon_tpu.utils import calibrate
+        r_dev, _, _ = calibrate.get_rates(
+            "align", n_dev, float(self.DEV_NS_PER_ROW),
+            float(self.CPU_NS_PER_CELL))
+        units = float(sum(len(q) for q in queries))
+        pred = calibrate.predict_chunk_wall("align", units, r_dev,
+                                            n_dev)
+        obs_calhealth.observe("align_band", pred, t1 - t0,
+                              registry=self.metrics)
+        obs_decision.DECISIONS.record(
+            "align_chunk", engine="band", rung=int(blq),
+            units=round(units, 1), predicted_s=round(pred, 6),
+            measured_s=round(t1 - t0, 6))
         self.align_cells += cells
         skip = set(unresolved.tolist())
         for idx, o in enumerate(chunk):

@@ -56,6 +56,12 @@ def _log_contact(key_parts: tuple, outcome: str) -> None:
     # GLOBAL registry and surface in the run report's "process" section
     from racon_tpu.obs.metrics import REGISTRY
     REGISTRY.add(f"aot_shelf_{outcome}")
+    # decision record (r16): which kernel variant was selected and
+    # whether the shelf served it — `racon-tpu explain` attributes
+    # cold-start walls to these first contacts
+    from racon_tpu.obs.decision import DECISIONS
+    DECISIONS.record("shelf", outcome=outcome,
+                     variant="/".join(str(p) for p in key_parts))
     import sys
     print(f"[racon_tpu::aot_shelf] {outcome}: "
           f"{'/'.join(str(p) for p in key_parts)}", file=sys.stderr)

@@ -180,6 +180,26 @@ def predict_walls(align_s: float, poa_s: float,
     return out
 
 
+#: device-rate unit scale per stage: ``store_rates`` persists "poa"
+#: as us/cost-unit and the align stages as ns/unit (row / e-step), so
+#: inverting a rate back into a predicted wall needs the matching
+#: scale.  Kept here so the decision plane (racon_tpu/obs/calhealth)
+#: prices chunks with exactly the inverse of what calibration stored.
+RATE_SCALE_S = {"poa": 1e-6, "align": 1e-9, "align_wfa": 1e-9,
+                "align_band": 1e-9}
+
+
+def predict_chunk_wall(stage: str, units: float, dev_rate: float,
+                       n_dev: int) -> float:
+    """Predicted device wall (seconds) for ONE dispatch of ``units``
+    work units priced at ``dev_rate`` (the stage's calibrate rate, in
+    its native us/ns-per-unit scale) across ``n_dev`` devices — the
+    exact inverse of the ``store_rates`` measurement, so
+    calhealth's ratio is 1.0 when the rate is perfect."""
+    scale = RATE_SCALE_S.get(stage, 1e-9)
+    return float(units) * float(dev_rate) * scale / max(1, int(n_dev))
+
+
 def store_rates(stage: str, n_dev: int, dev_rate: float,
                 cpu_rate=None, provisional: bool = False) -> None:
     """Persist measured rates (two-pass-then-frozen per machine key +

@@ -1,0 +1,629 @@
+"""Decision-plane observability (ISSUE 12 / r16).
+
+Four layers, matching the explain story:
+
+* **unit** — decision-ring bounds (size/seq/dropped), off-switch,
+  job-context auto-tagging, job/kind/last filtering, per-kind counts;
+* **calhealth** — drift math against hand-computed ratios (EWMA
+  recursion, histogram p50/p99 through the registry ladder, the
+  DRIFT flag at the band edges), the host-stage ``observe_units``
+  self-learned rate (first sample scores ratio 1.0 by construction),
+  merged-snapshot handling, and ``predict_chunk_wall`` as the exact
+  inverse of the stored calibration rates;
+* **renderers** — the pure ``explain`` waterfall/drift/overview
+  renderers and the bench-gate ``drift_warnings`` helper;
+* **end-to-end** — a one-shot run with decisions pinned on emits
+  bytes identical to the obs-off golden while its flight dump carries
+  the ladder-path exemplars (align_probe/align_chunk/poa_chunk) and
+  its ``--metrics-json`` report renders through ``racon-tpu explain
+  --metrics-json``; a live daemon answers the ``explain`` op with
+  calhealth + job-filtered decision events and ``racon-tpu explain
+  --socket --job N`` renders the job's cost waterfall — and the
+  served bytes still match the golden.
+
+Daemon tests reuse tests/test_serve.py's conventions: pinned
+calibration rates for byte determinism, /tmp sockets, probe-connect
+startup.
+"""
+
+import base64
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from racon_tpu.obs import calhealth  # noqa: E402
+from racon_tpu.obs import context as obs_context  # noqa: E402
+from racon_tpu.obs import decision as obs_decision  # noqa: E402
+from racon_tpu.obs import flight as obs_flight  # noqa: E402
+from racon_tpu.obs.metrics import Registry  # noqa: E402
+from racon_tpu.serve import client  # noqa: E402
+from racon_tpu.serve import explain as serve_explain  # noqa: E402
+from racon_tpu.utils import calibrate  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# decision ring unit
+# ---------------------------------------------------------------------------
+
+def test_decision_ring_bounds_and_seq():
+    dr = obs_decision.DecisionRecorder(maxlen=24)
+    for i in range(40):
+        dr.record("poa_chunk", i=i)
+    st = dr.stats()
+    assert st["size"] == 24
+    assert st["capacity"] == 24
+    assert st["recorded"] == 40
+    assert st["dropped"] == 16
+    evs = dr.snapshot()
+    # oldest first, monotone seq, the oldest 16 evicted
+    assert [ev["seq"] for ev in evs] == list(range(17, 41))
+    assert all(ev["kind"] == "poa_chunk" and ev["t"] >= 0
+               for ev in evs)
+    assert [ev["seq"] for ev in dr.snapshot(last=5)] == \
+        list(range(36, 41))
+
+
+def test_decision_off_switch(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_DECISIONS", "0")
+    dr = obs_decision.DecisionRecorder(maxlen=24)
+    dr.record("poa_chunk")
+    st = dr.stats()
+    assert st["size"] == 0 and st["recorded"] == 0
+    assert st["enabled"] is False
+
+
+def test_decision_context_tagging_and_none_drop():
+    dr = obs_decision.DecisionRecorder(maxlen=24)
+    with obs_context.job_context(17, "tenantA") as ctx:
+        dr.record("align_chunk", engine="wfa", rung=256,
+                  predicted_s=0.5, measured_s=None)
+    (ev,) = dr.snapshot()
+    assert ev["job"] == 17 and ev["tenant"] == "tenantA"
+    assert ev["trace_id"] == ctx.trace_id
+    assert ev["engine"] == "wfa" and ev["rung"] == 256
+    assert "measured_s" not in ev   # None fields are dropped
+    # explicit tags win over the (absent) context
+    dr.record("job_wall", job=9, tenant="tB", ratio=1.25)
+    ev = dr.snapshot()[-1]
+    assert ev["job"] == 9 and ev["tenant"] == "tB"
+
+
+def test_decision_snapshot_filters_and_counts():
+    dr = obs_decision.DecisionRecorder(maxlen=64)
+    dr.record("align_chunk", job=1, engine="wfa")
+    dr.record("align_chunk", job=2, engine="band")
+    dr.record("poa_chunk", job=1)
+    dr.record("shelf", outcome="hit")
+    assert [ev["kind"] for ev in dr.snapshot(job=1)] == \
+        ["align_chunk", "poa_chunk"]
+    assert len(dr.snapshot(kind="align_chunk")) == 2
+    assert [ev["job"] for ev in
+            dr.snapshot(kind="align_chunk", last=1)] == [2]
+    assert dr.counts() == {"align_chunk": 2, "poa_chunk": 1,
+                           "shelf": 1}
+    assert dr.counts(job=1) == {"align_chunk": 1, "poa_chunk": 1}
+
+
+# ---------------------------------------------------------------------------
+# calhealth drift math
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def fresh_cal():
+    calhealth._reset_for_tests()
+    yield
+    calhealth._reset_for_tests()
+
+
+def test_calhealth_ewma_hand_computed(fresh_cal):
+    reg = Registry()
+    # ratios 2.0, 1.0, 0.5 -> EWMA recursion with alpha 0.2:
+    #   2.0, 2.0 + .2*(1.0-2.0) = 1.8, 1.8 + .2*(0.5-1.8) = 1.54
+    calhealth.observe("poa", 1.0, 2.0, registry=reg)
+    calhealth.observe("poa", 2.0, 2.0, registry=reg)
+    calhealth.observe("poa", 4.0, 2.0, registry=reg)
+    snap = reg.snapshot()
+    assert snap["gauges"]["calhealth_ewma.poa"] == pytest.approx(
+        1.54, abs=1e-6)
+    assert snap["counters"]["calhealth_n.poa"] == 3
+    h = snap["histograms"]["calhealth_ratio.poa"]
+    assert h["count"] == 3
+    assert h["min"] == pytest.approx(0.5)
+    assert h["max"] == pytest.approx(2.0)
+    row = calhealth.summary(snap)["stages"]["poa"]
+    assert row["n"] == 3
+    assert row["ewma"] == pytest.approx(1.54, abs=1e-6)
+    assert row["drift"] is False            # 1.54 inside [0.5, 2.0]
+    # quantiles ride the registry's log ladder: exact-merge clamped
+    assert row["min"] <= row["p50"] <= row["max"]
+    assert row["p50"] <= row["p99"] <= row["max"]
+    assert calhealth.stage_ewma(snap, "poa") == row["ewma"]
+    assert calhealth.stage_ewma(snap, "align_wfa") is None
+
+
+def test_calhealth_drift_flag_and_guards(fresh_cal):
+    reg = Registry()
+    # non-positive predictions carry no ratio: dropped
+    calhealth.observe("poa", 0.0, 1.0, registry=reg)
+    calhealth.observe("poa", -1.0, 1.0, registry=reg)
+    calhealth.observe("poa", None, 1.0, registry=reg)
+    assert calhealth.summary(reg.snapshot())["stages"] == {}
+    # 3x over prediction -> outside [0.5, 2.0] -> advisory flag
+    calhealth.observe("align_wfa", 1.0, 3.0, registry=reg)
+    row = calhealth.summary(reg.snapshot())["stages"]["align_wfa"]
+    assert row["ewma"] == pytest.approx(3.0)
+    assert row["drift"] is True
+
+
+def test_calhealth_observe_units_seeds_at_ratio_one(fresh_cal):
+    reg = Registry()
+    # first sample defines the learned rate: ratio exactly 1.0
+    calhealth.observe_units("host.parse", 100, 1.0, registry=reg)
+    snap = reg.snapshot()
+    assert snap["gauges"]["calhealth_ewma.host.parse"] == \
+        pytest.approx(1.0)
+    # rate after seeding: 0.01 + 0.2*(0.01-0.01) = 0.01 s/unit;
+    # second sample at 0.03 s/unit -> predicted 1.0 s, actual 3.0 s
+    calhealth.observe_units("host.parse", 100, 3.0, registry=reg)
+    snap = reg.snapshot()
+    h = snap["histograms"]["calhealth_ratio.host.parse"]
+    assert h["count"] == 2
+    assert h["max"] == pytest.approx(3.0)
+    # EWMA: 1.0 + 0.2*(3.0 - 1.0) = 1.4
+    assert snap["gauges"]["calhealth_ewma.host.parse"] == \
+        pytest.approx(1.4, abs=1e-6)
+
+
+def test_calhealth_summary_on_merged_snapshot(fresh_cal):
+    from racon_tpu.obs import aggregate
+
+    a, b = Registry(), Registry()
+    calhealth.observe("poa", 1.0, 1.0, registry=a)
+    calhealth._reset_for_tests()   # daemon B has its own EWMA state
+    calhealth.observe("poa", 1.0, 3.0, registry=b)
+    merged = aggregate.merge_snapshots(
+        {"dA": a.snapshot(), "dB": b.snapshot()})
+    row = calhealth.summary(merged)["stages"]["poa"]
+    # union histogram: both observations counted
+    assert row["n"] == 2
+    # merged EWMA gauge reports the per-source mean: (1.0 + 3.0)/2
+    assert row["ewma"] == pytest.approx(2.0, abs=1e-6)
+
+
+def test_predict_chunk_wall_inverts_stored_rates():
+    # store_rates persists poa in us/unit and align in ns/unit;
+    # predict_chunk_wall must undo exactly that scaling, spread over
+    # the device count
+    assert calibrate.predict_chunk_wall("poa", 1000, 0.30, 1) == \
+        pytest.approx(1000 * 0.30 * 1e-6)
+    assert calibrate.predict_chunk_wall("poa", 1000, 0.30, 4) == \
+        pytest.approx(1000 * 0.30 * 1e-6 / 4)
+    assert calibrate.predict_chunk_wall("align", 5000, 1100, 2) == \
+        pytest.approx(5000 * 1100 * 1e-9 / 2)
+    assert calibrate.predict_chunk_wall("align_wfa", 64, 700, 1) == \
+        pytest.approx(64 * 700 * 1e-9)
+
+
+# ---------------------------------------------------------------------------
+# explain renderers (pure) + bench-gate drift warnings
+# ---------------------------------------------------------------------------
+
+_CAL = {"band": [0.5, 2.0],
+        "stages": {
+            "poa": {"n": 12, "ewma": 1.07, "p50": 1.05, "p99": 1.31,
+                    "min": 0.9, "max": 1.4, "drift": False},
+            "align_wfa": {"n": 4, "ewma": 2.41, "p50": 2.38,
+                          "p99": 2.6, "min": 2.2, "max": 2.6,
+                          "drift": True}}}
+
+_EXPLAIN_DOC = {
+    "ok": True, "pid": 123,
+    "ring": {"enabled": True, "size": 6, "capacity": 2048,
+             "recorded": 6, "dropped": 0},
+    "counts": {"align_chunk": 2, "poa_chunk": 1, "job_stages": 1,
+               "job_wall": 1},
+    "calhealth": _CAL,
+    "events": [
+        {"seq": 1, "t": 1.0, "kind": "align_chunk", "job": 17,
+         "tenant": "tenantA", "engine": "wfa", "rung": 256,
+         "units": 64, "predicted_s": 0.1, "measured_s": 0.24},
+        {"seq": 2, "t": 1.2, "kind": "poa_chunk", "job": 17,
+         "tenant": "tenantA", "units": 300, "predicted_s": 0.5,
+         "measured_s": 0.55},
+        {"seq": 3, "t": 2.0, "kind": "job_stages", "job": 17,
+         "tenant": "tenantA", "wall_s": 4.52,
+         "stage_walls": {"device_poa": 2.21, "device_align": 1.13,
+                         "host.parse": 0.4},
+         "split_mode": "rate-model"},
+        {"seq": 4, "t": 2.1, "kind": "job_wall", "job": 17,
+         "tenant": "tenantA", "predicted_s": 4.1, "measured_s": 4.52,
+         "ratio": 1.102},
+        {"seq": 5, "t": 3.0, "kind": "align_chunk", "job": 18,
+         "engine": "band", "rung": 512, "units": 9,
+         "predicted_s": 0.2, "measured_s": 0.2},
+    ],
+}
+
+
+def test_explain_render_waterfall():
+    out = serve_explain.render_waterfall(
+        {"device_poa": 2.21, "device_align": 1.13, "host.parse": 0.4},
+        total_s=4.52)
+    # descending wall order, share of the TOTAL wall, bar scaled
+    lines = out.splitlines()
+    assert "stage" in lines[0] and "share" in lines[0]
+    assert lines[1].lstrip().startswith("device_poa")
+    assert "49%" in lines[1] and "#" in lines[1]
+    assert lines[2].lstrip().startswith("device_align")
+    assert "25%" in lines[2]
+    # unaccounted wall shows as (other) when > 5% of the total
+    assert "(other)" in out
+    assert "no stage walls" in serve_explain.render_waterfall({})
+
+
+def test_explain_render_drift():
+    out = serve_explain.render_drift(_CAL)
+    assert "band 0.50..2.00" in out
+    assert "poa" in out and "1.070" in out
+    assert "align_wfa" in out and "DRIFT" in out
+    # the advisory line names the stage, the direction and the knob
+    assert "! align_wfa:" in out
+    assert "slower" in out
+    assert "recalibration recommended" in out
+    assert "RACON_TPU_RECALIBRATE=1" in out
+    # healthy stages get no advisory
+    assert "! poa:" not in out
+    assert "no predicted-vs-actual samples" in \
+        serve_explain.render_drift({"band": [0.5, 2.0], "stages": {}})
+
+
+def test_explain_render_job():
+    out = serve_explain.render_job(_EXPLAIN_DOC, 17)
+    assert out.startswith("job 17 (tenantA)")
+    # headline: the admission prediction vs the measured wall
+    assert "predicted 4.10s" in out and "measured 4.52s" in out
+    assert "ratio 1.10" in out
+    assert "poa split mode: rate-model" in out
+    assert "device_poa" in out and "49%" in out
+    # per-kind counts over the JOB's events only (job 18's align
+    # chunk is excluded)
+    assert "align_chunk=1" in out
+    assert "poa_chunk=1" in out
+    # the drift table rides every view
+    assert "calibration health" in out and "DRIFT" in out
+    # unknown job: explicit, not a crash — and still shows drift
+    out = serve_explain.render_job(_EXPLAIN_DOC, 99)
+    assert "no decision records" in out
+    assert "calibration health" in out
+
+
+def test_explain_render_overview():
+    out = serve_explain.render_overview(_EXPLAIN_DOC)
+    assert "decision ring @ pid 123: 6/2048" in out
+    assert "align_chunk=2" in out and "job_wall=1" in out
+    assert "calibration health" in out
+    off = dict(_EXPLAIN_DOC)
+    off["ring"] = {"enabled": False, "size": 0, "capacity": 16,
+                   "recorded": 0, "dropped": 0}
+    assert "RECORDING OFF" in serve_explain.render_overview(off)
+
+
+def test_top_render_drift_column():
+    from racon_tpu.serve import top as serve_top
+
+    doc = {"pid": 1, "uptime_s": 5.0, "queue": {},
+           "device_util": {
+               "poa": {"util": 0.5, "busy_s": 1.0, "idle_s": 1.0,
+                       "n_dispatches": 3},
+               "align_wfa": {"util": 0.25, "busy_s": 0.5,
+                             "idle_s": 1.5, "n_dispatches": 2}},
+           "calhealth": {
+               "band": [0.5, 2.0],
+               "stages": {
+                   "poa": {"n": 3, "ewma": 1.07, "drift": False},
+                   "align_wfa": {"n": 2, "ewma": 2.41,
+                                 "drift": True},
+                   "host.parse": {"n": 1, "ewma": 1.0,
+                                  "drift": False}}}}
+    out = serve_top.render(doc)
+    assert "drift" in out
+    poa_row = next(ln for ln in out.splitlines()
+                   if ln.startswith("poa"))
+    assert "1.07" in poa_row
+    wfa_row = next(ln for ln in out.splitlines()
+                   if ln.startswith("align_wfa"))
+    assert "2.41!" in wfa_row          # "!" marks out-of-band drift
+    # host stages have no engine row; they ride below with drift only
+    host_row = next(ln for ln in out.splitlines()
+                    if ln.startswith("host.parse"))
+    assert "1.00" in host_row
+
+
+def test_bench_gate_drift_warnings():
+    sys.path.insert(0, os.path.join(REPO_ROOT, "ci", "common"))
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    warnings = bench_gate.drift_warnings({"calhealth": _CAL})
+    assert len(warnings) == 1
+    assert "align_wfa" in warnings[0]
+    assert "2.41" in warnings[0]
+    assert "RACON_TPU_RECALIBRATE=1" in warnings[0]
+    # records without the block (older bench / CPU path) warn nothing
+    assert bench_gate.drift_warnings({}) == []
+    assert bench_gate.drift_warnings({"calhealth": {}}) == []
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: byte identity, ladder exemplars, explain op + CLI
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_tmp():
+    with tempfile.TemporaryDirectory(prefix="rtdecision_",
+                                     dir="/tmp") as d:
+        yield d
+
+
+@pytest.fixture(scope="module")
+def dataset(serve_tmp):
+    from racon_tpu.tools import simulate
+
+    return simulate.simulate(os.path.join(serve_tmp, "data"),
+                             genome_len=8_000, coverage=5,
+                             read_len=800, seed=33, ont=True)
+
+
+def _env(serve_tmp, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "RACON_TPU_CACHE_DIR": os.path.join(serve_tmp, "cache"),
+        "RACON_TPU_CLI_PREWARM": "0",
+        "RACON_TPU_RATE_POA_DEV": "0.30",
+        "RACON_TPU_RATE_POA_CPU": "2.0",
+        "RACON_TPU_RATE_ALIGN_DEV": "1100",
+        "RACON_TPU_RATE_ALIGN_CPU": "4.0",
+        "RACON_TPU_RATE_ALIGN_WFA_DEV": "700",
+        "RACON_TPU_RATE_ALIGN_WFA_CPU": "1.0",
+    })
+    for k in ("RACON_TPU_TRACE", "RACON_TPU_METRICS_JSON",
+              "RACON_TPU_FLIGHT_DUMP", "RACON_TPU_DECISIONS",
+              "RACON_TPU_DECISIONS_RING"):
+        env.pop(k, None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _cli(dataset, serve_tmp, extra_env=None, args=()):
+    reads, paf, draft = dataset
+    return subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "4", "-c", "1",
+         "--tpualigner-batches", "1", *args, reads, paf, draft],
+        cwd=REPO_ROOT, capture_output=True,
+        env=_env(serve_tmp, extra_env), timeout=600)
+
+
+@pytest.fixture(scope="module")
+def golden(dataset, serve_tmp):
+    """Obs-off one-shot bytes — the identity reference."""
+    run = _cli(dataset, serve_tmp,
+               extra_env={"RACON_TPU_FLIGHT": "0",
+                          "RACON_TPU_DECISIONS": "0"})
+    assert run.returncode == 0, run.stderr.decode()
+    assert run.stdout.startswith(b">")
+    return run.stdout
+
+
+def test_cli_decisions_on_byte_identity_and_exemplars(
+        dataset, serve_tmp, golden, tmp_path):
+    """Decisions pinned on (with a tiny ring, so eviction runs on
+    every path) must change zero output bytes, and the flight dump's
+    decision section must carry the ladder-path exemplars."""
+    dump = str(tmp_path / "decisions-flight.json")
+    report = str(tmp_path / "report.json")
+    run = _cli(dataset, serve_tmp,
+               extra_env={"RACON_TPU_DECISIONS": "1",
+                          "RACON_TPU_DECISIONS_RING": "64",
+                          "RACON_TPU_FLIGHT_DUMP": dump},
+               args=("--metrics-json", report))
+    assert run.returncode == 0, run.stderr.decode()
+    assert run.stdout == golden, (
+        "decisions-on run diverged from the obs-off bytes")
+
+    # decision exemplars ride the flight dump (post-mortem story)
+    doc = obs_flight.load_dump(dump)
+    dec = doc.get("decisions") or {}
+    assert dec.get("ring", {}).get("recorded", 0) > 0
+    kinds = {ev["kind"] for ev in dec.get("events", ())}
+    # the ladder path left exemplars: the align split verdict, at
+    # least one align dispatch with predicted-vs-measured, and the
+    # POA split-model decision (the probe/WFA-rung records are
+    # Pallas-ladder only; the CPU backend runs the scan ladder)
+    assert "align_split" in kinds, kinds
+    assert "align_chunk" in kinds, kinds
+    assert "poa_split" in kinds, kinds
+    chunk = next(ev for ev in dec["events"]
+                 if ev["kind"] == "align_chunk")
+    assert chunk["engine"] in ("wfa", "band")
+    assert chunk["predicted_s"] > 0 and chunk["measured_s"] >= 0
+
+    # the run report carries the calhealth metrics: drift is
+    # recomputable offline, and the explain CLI renders it
+    with open(report) as f:
+        rep = json.load(f)
+    summ = calhealth.summary(rep["run"])
+    assert summ["stages"], "no calhealth samples in the run report"
+    assert any(s.startswith("host.") for s in summ["stages"])
+    exp = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "explain",
+         "--metrics-json", report],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert exp.returncode == 0, exp.stderr
+    assert "calibration health" in exp.stdout
+    assert "host.parse" in exp.stdout
+    # the report's stage walls render as the waterfall
+    assert "share" in exp.stdout
+
+
+@pytest.fixture(scope="module")
+def divergent_dataset(serve_tmp):
+    """High-divergence reads (30% error, 2 kb): the true per-pair
+    edit cost (~0.32 x 2000 = 640) breaks the 512 rung's certificate
+    while the default admission estimate (0.2 x dim) still admits
+    there — a guaranteed, deterministic ladder retry."""
+    from racon_tpu.tools import simulate
+
+    return simulate.simulate(os.path.join(serve_tmp, "divdata"),
+                             genome_len=12_000, coverage=5,
+                             read_len=2_000, read_error=0.30,
+                             seed=33, ont=True)
+
+
+def test_cli_forced_retry_leaves_ladder_exemplars(
+        divergent_dataset, serve_tmp, tmp_path):
+    """A divergence-underestimated ladder must leave retry (or
+    CPU-fallthrough) exemplars in the decision ring — the 'replay one
+    pair's ladder path' claim.  Device-only split so the retrying
+    pairs cannot drain to the CPU side first."""
+    dump = str(tmp_path / "retry-flight.json")
+    run = _cli(divergent_dataset, serve_tmp,
+               extra_env={"RACON_TPU_DECISIONS": "1",
+                          "RACON_TPU_ALIGN_DEVICE_ONLY": "1",
+                          "RACON_TPU_FLIGHT_DUMP": dump})
+    assert run.returncode == 0, run.stderr.decode()
+    doc = obs_flight.load_dump(dump)
+    evs = (doc.get("decisions") or {}).get("events", [])
+    kinds = {ev["kind"] for ev in evs}
+    # the underestimated rung must overflow for this divergence:
+    # pairs either climbed the ladder (align_retry) or fell off it
+    # (align_cpu_fallthrough); both are ladder-path exemplars
+    assert kinds & {"align_retry", "align_cpu_fallthrough"}, kinds
+    for ev in evs:
+        if ev["kind"] == "align_retry":
+            assert ev["engine"] in ("wfa", "band")
+            assert ev["pairs"] > 0
+
+
+def _spec(dataset, tenant="default"):
+    reads, paf, draft = dataset
+    return {"sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 4, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1, "tenant": tenant}
+
+
+def _start_server(serve_tmp, name, args=(), extra_env=None):
+    sock_path = os.path.join(serve_tmp, name + ".sock")
+    log = open(os.path.join(serve_tmp, name + ".log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve",
+         "--socket", sock_path, *args],
+        cwd=REPO_ROOT, stdout=log, stderr=log,
+        env=_env(serve_tmp, extra_env))
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            log.close()
+            raise AssertionError(
+                "server died at startup: " + open(log.name).read())
+        if os.path.exists(sock_path):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock_path)
+            except OSError:
+                pass
+            else:
+                log.close()
+                return proc, sock_path
+            finally:
+                probe.close()
+        time.sleep(0.2)
+    proc.kill()
+    log.close()
+    raise AssertionError("server socket never came up")
+
+
+def _explain_cli(args):
+    return subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "explain", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+
+
+def test_daemon_explain_e2e(dataset, serve_tmp, golden):
+    """One daemon, decisions pinned on: the explain op serves
+    calhealth + job-filtered decision events, the CLI renders the
+    per-job cost waterfall, the metrics frame carries calhealth —
+    and the served bytes still match the obs-off golden."""
+    proc, sock = _start_server(
+        serve_tmp, "decision",
+        extra_env={"RACON_TPU_DECISIONS": "1"})
+    try:
+        resp = client.submit(sock, _spec(dataset, tenant="tenantA"))
+        assert resp["ok"], resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden, (
+            "served job under decisions diverged from the obs-off "
+            "bytes")
+        jid = resp["job_id"]
+
+        # --- explain op: ring + counts + calhealth -----------------
+        doc = client.explain(sock)
+        assert doc["ok"] and doc["ring"]["recorded"] > 0
+        assert doc["counts"].get("job_stages", 0) >= 1
+        assert doc["counts"].get("job_wall", 0) >= 1
+        assert "daemon_id" in (doc.get("identity") or {})
+        stages = doc["calhealth"]["stages"]
+        assert stages, "daemon served no calhealth samples"
+        for row in stages.values():
+            assert {"n", "ewma", "p50", "p99", "drift"} <= set(row)
+
+        # --- job filter: the rollups are job-tagged ----------------
+        doc = client.explain(sock, job=jid)
+        kinds = {ev["kind"] for ev in doc["events"]}
+        assert "job_stages" in kinds, kinds
+        assert "job_wall" in kinds, kinds
+        st = next(ev for ev in doc["events"]
+                  if ev["kind"] == "job_stages")
+        assert st["tenant"] == "tenantA"
+        assert st["wall_s"] > 0 and st["stage_walls"]
+        jw = next(ev for ev in doc["events"]
+                  if ev["kind"] == "job_wall")
+        assert jw["predicted_s"] > 0 and jw["measured_s"] > 0
+        assert jw["ratio"] == pytest.approx(
+            jw["measured_s"] / jw["predicted_s"], rel=1e-3)
+
+        # --- explain CLI: the per-job cost waterfall ---------------
+        run = _explain_cli(["--socket", sock, "--job", str(jid)])
+        assert run.returncode == 0, run.stderr
+        assert f"job {jid} (tenantA)" in run.stdout
+        assert "predicted" in run.stdout and "measured" in run.stdout
+        assert "share" in run.stdout           # the waterfall table
+        assert "calibration health" in run.stdout
+        run = _explain_cli(["--socket", sock])
+        assert run.returncode == 0, run.stderr
+        assert "decision ring @ pid" in run.stdout
+        run = _explain_cli(["--socket", sock, "--json"])
+        assert run.returncode == 0, run.stderr
+        assert json.loads(run.stdout)["ok"] is True
+
+        # --- calhealth rides the metrics frame (top's source) ------
+        mdoc = client.metrics(sock)
+        assert mdoc["ok"] and mdoc["calhealth"]["stages"]
+        from racon_tpu.serve import top as serve_top
+        assert "drift" in serve_top.render(mdoc)
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -244,23 +244,30 @@ _EVENTS = [
     {"seq": 4, "t": 12.31, "kind": "done", "job": 17,
      "tenant": "tenantA", "ok": True, "exec_wall_s": 2.298},
     {"seq": 5, "t": 13.0, "kind": "drain", "queued": 0, "running": 1},
+    # poisoned-unit fallback (r16): the executor mirrors the retry
+    # into the flight ring tagged with the fused dispatch's jobs
+    {"seq": 6, "t": 11.02, "kind": "unit_retry", "jobs": [17, 18],
+     "unit_kind": "poa", "tenant": "tenantB", "items": 48,
+     "error": "XlaRuntimeError"},
 ]
 
 
 def test_inspect_job_events_filter_spans_fused():
     evs = serve_inspect.job_events(_EVENTS, 17)
-    assert [ev["seq"] for ev in evs] == [1, 2, 3, 4]
-    # job 18 only rode the fused dispatch
+    assert [ev["seq"] for ev in evs] == [1, 2, 3, 6, 4]
+    # job 18 only rode the fused dispatch (and its retry)
     assert [ev["seq"] for ev in serve_inspect.job_events(
-        _EVENTS, 18)] == [3]
+        _EVENTS, 18)] == [3, 6]
 
 
 def test_inspect_timeline_render():
     out = serve_inspect.render_timeline(_EVENTS, 17)
-    assert out.startswith("job 17 (tenantA) — 4 flight event(s)")
+    assert out.startswith("job 17 (tenantA) — 5 flight event(s)")
     assert "queue wait 0.012s" in out
     assert "poa units=2 items=96 occupancy=0.75" in out
     assert "tenants=tenantA,tenantB" in out
+    assert ("unit_retry" in out
+            and "tenant=tenantB items=48 error=XlaRuntimeError" in out)
     assert "ok exec_wall=2.298s" in out
     # relative times from the job's first event
     assert "+    0.000s  admit" in out

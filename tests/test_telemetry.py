@@ -296,7 +296,12 @@ def test_bench_gate_against_committed_trajectory():
     ref = gate_mod.reference_value(
         gate_mod.load_trajectory(REPO_ROOT), "value")
     assert ref and ref > 0
-    r = _gate(dict(newest, value=ref * 1.25), REPO_ROOT)
+    injected = dict(newest, value=ref * 1.25)
+    # a provenance-marked wall (carried forward / simulated-dataset
+    # fallback, r16) is exempt from gating — the injected regression
+    # must read as a real measurement to be flagged
+    injected.pop("value_provenance", None)
+    r = _gate(injected, REPO_ROOT)
     assert r.returncode == 1, r.stderr
     assert "REGRESSED" in r.stderr
 
