@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Durability chaos lane (ISSUE 13 CI satellite): runs the durable
+# serve-tier suite — write-ahead journal mechanics, journal replay,
+# stale-socket takeover, idempotent job keys, and the acceptance
+# pin: a daemon SIGKILL'd by the deterministic fault harness
+# (RACON_TPU_FAULT=<site>:<nth>) at EVERY crash site mid-job, then
+# restarted on the same socket + journal, resumes the interrupted
+# job from its megabatch checkpoints to byte-identical FASTA.
+# The daemon/chaos tests are @pytest.mark.slow — the tier-1 sweep
+# (-m 'not slow') keeps only the fast journal/replay unit tests, so
+# this lane (no marker filter) is where the kill/restart pins run.
+# Hardening mirrors the serve lane:
+#   * JAX_PLATFORMS=cpu + 8 virtual devices (tests/conftest.py)
+#     exercises the sharded dispatch path without hardware;
+#   * the journal is pinned ON (a stray RACON_TPU_JOURNAL=0 in the
+#     CI env must not silently turn the chaos lane into a no-op);
+#   * PYTHONDEVMODE=1 surfaces unclosed journal/socket fds across
+#     the kill/restart cycles;
+#   * pytest's faulthandler timeout dumps every thread's traceback
+#     if a recovery hangs — a daemon that never resumes shows up as
+#     a stack dump naming the blocked wait, not an opaque timeout.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export PYTHONDEVMODE=1
+export RACON_TPU_JOURNAL=1
+unset RACON_TPU_FAULT || true
+python -m pytest tests/test_durable.py -q \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"

@@ -40,7 +40,13 @@ USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequenc
                  stdout contract as the one-shot form; --trace FILE
                  saves the job's server-side trace slice;
                  --trace-context ID propagates a caller trace id
-                 into the daemon's spans and flight events)
+                 into the daemon's spans and flight events;
+                 --job-key KEY makes the submit idempotent — a
+                 duplicate key joins the live job or is answered
+                 from the daemon's write-ahead journal record;
+                 --retry N retries retryable failures — queue_full,
+                 draining, daemon restarting — with jittered
+                 exponential backoff)
         status   print a daemon's queue/registry/provenance snapshot
                  (--json for the raw document)
         top      live telemetry view over the daemon's watch stream;

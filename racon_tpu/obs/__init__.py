@@ -68,12 +68,13 @@ from racon_tpu.obs.flight import FLIGHT, FlightRecorder
 from racon_tpu.obs.metrics import (HIST_BUCKETS, REGISTRY, MetricAttr,
                                    Registry, hist_quantile)
 from racon_tpu.obs.trace import (TRACER, device_span, enable_trace, now,
-                                 span, write_trace)
+                                 span, wall_now, write_trace)
 
 __all__ = [
     "REGISTRY", "Registry", "MetricAttr", "TRACER",
     "HIST_BUCKETS", "hist_quantile", "DEVICE_UTIL", "DeviceUtil",
-    "now", "span", "device_span", "enable_trace", "write_trace",
+    "now", "wall_now", "span", "device_span", "enable_trace",
+    "write_trace",
     "JobContext", "job_context", "current", "jobs_for_tenant",
     "valid_trace_id", "FLIGHT", "FlightRecorder",
     "DECISIONS", "DecisionRecorder", "DRIFT_BAND",

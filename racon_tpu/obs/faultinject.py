@@ -1,0 +1,78 @@
+"""Deterministic fault injection for the durability tests (r17).
+
+``RACON_TPU_FAULT=<site>[:<nth>]`` arms exactly one named crash
+site; the ``nth`` time execution reaches that site (default 1) the
+process SIGKILLs ITSELF — the same abrupt death an OOM kill or a
+power loss delivers, with none of the interpreter teardown a normal
+exit would run (no atexit, no flushes, no socket unlink).  That is
+the point: the crash-recovery tests (tests/test_durable.py) and the
+``ci/cpu/durable_tier1.sh`` chaos lane kill the serve daemon at each
+site mid-job and pin that a restart on the same journal resumes to
+byte-identical FASTA.
+
+Sites in use (racon_tpu/serve + racon_tpu/tpu/polisher):
+
+* ``post-admit``      — job journaled + queued, never started
+* ``mid-megabatch``   — POA megabatch dispatched, result in flight
+* ``pre-demux``       — device results collected, not yet committed
+* ``pre-done-record`` — job finished, done record never journaled
+* ``journal-write``   — inside the journal append, before the write
+
+Counting is per-process and lock-guarded, so ``<site>:<nth>`` is
+deterministic under concurrent workers.  An unarmed site costs one
+env read and returns; production runs never set the knob (registered
+in provenance.KNOWN_KNOBS so its presence shows in run reports).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+SITES = ("post-admit", "mid-megabatch", "pre-demux",
+         "pre-done-record", "journal-write")
+
+_lock = threading.Lock()
+_counts: dict = {}
+
+
+def spec():
+    """Parse ``RACON_TPU_FAULT`` -> ``(site, nth)`` or ``None``.
+    Malformed values disarm rather than raise: a typo in a chaos
+    knob must not take down a production daemon."""
+    raw = os.environ.get("RACON_TPU_FAULT")
+    if not raw:
+        return None
+    site, _, nth = raw.partition(":")
+    site = site.strip()
+    if site not in SITES:
+        return None
+    try:
+        n = int(nth) if nth else 1
+    except ValueError:
+        return None
+    if n < 1:
+        return None
+    return (site, n)
+
+
+def hit(site: str) -> None:
+    """Mark one arrival at ``site``; SIGKILL the process when the
+    armed site reaches its nth arrival.  No-op when unarmed."""
+    armed = spec()
+    if armed is None or armed[0] != site:
+        return
+    with _lock:
+        _counts[site] = _counts.get(site, 0) + 1
+        count = _counts[site]
+    if count == armed[1]:
+        print(f"[racon_tpu::faultinject] site {site!r} hit "
+              f"{count}: SIGKILL", file=sys.stderr, flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _reset_for_tests() -> None:
+    with _lock:
+        _counts.clear()

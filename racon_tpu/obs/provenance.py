@@ -95,6 +95,16 @@ KNOWN_KNOBS = {
     # it, control flow never does)
     "RACON_TPU_DECISIONS": "1",
     "RACON_TPU_DECISIONS_RING": "2048",
+    # durability plane (r17, racon_tpu/serve/journal.py): the serve
+    # tier's write-ahead job journal ("0" = exactly the pre-r17
+    # daemon), where it lives (default: beside the socket), whether
+    # every append fsyncs, and the deterministic fault-injection
+    # harness (racon_tpu/obs/faultinject.py, "<site>:<nth>" —
+    # test-only, SIGKILLs the process at the nth arrival)
+    "RACON_TPU_JOURNAL": "1",
+    "RACON_TPU_JOURNAL_DIR": "",
+    "RACON_TPU_JOURNAL_FSYNC": "1",
+    "RACON_TPU_FAULT": "",
 }
 
 # host-capability probe reference wall (bench.py's budget scaling):

@@ -43,6 +43,11 @@ from contextlib import contextmanager, nullcontext
 #: obs lint); trace timestamps are offsets from _EPOCH in microseconds
 now = time.monotonic
 
+#: the one sanctioned WALL clock — for forensic stamps that must stay
+#: comparable across process incarnations (the serve journal's record
+#: timestamps); measurements still go through now()/span()
+wall_now = time.time
+
 _EPOCH = time.monotonic()
 
 

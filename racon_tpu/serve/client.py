@@ -61,7 +61,7 @@ def request(socket_path: str, frame: dict, timeout: float = None):
 
 def submit(socket_path: str, spec: dict, priority: int = 0,
            timeout: float = None, want_trace: bool = False,
-           trace_context: str = None) -> dict:
+           trace_context: str = None, job_key: str = None) -> dict:
     """Submit one job and block until it completes (or is rejected).
     Returns the raw response frame; callers check ``resp["ok"]``.
     ``want_trace`` asks the server to attach the job's trace slice
@@ -69,13 +69,66 @@ def submit(socket_path: str, spec: dict, priority: int = 0,
     ``trace_context`` is an optional caller-chosen trace id
     (traceparent-style, ``[A-Za-z0-9._:-]{1,128}``); the daemon
     adopts it as the job's trace id so spans, flight events and
-    ``inspect`` timelines across daemons share one id."""
+    ``inspect`` timelines across daemons share one id.
+    ``job_key`` (same charset) is the idempotence key (r17): a
+    duplicate submit with the same key joins the live job or is
+    answered from the daemon's write-ahead journal record — the job
+    runs exactly once, across client retries AND daemon restarts."""
     frame = {"op": "submit", "job": spec, "priority": priority}
     if want_trace:
         frame["trace"] = True
     if trace_context is not None:
         frame["trace_context"] = trace_context
+    if job_key is not None:
+        frame["job_key"] = job_key
     return request(socket_path, frame, timeout=timeout)
+
+
+def submit_with_retry(socket_path: str, spec: dict,
+                      priority: int = 0, retries: int = 0,
+                      timeout: float = None, want_trace: bool = False,
+                      trace_context: str = None,
+                      job_key: str = None) -> dict:
+    """:func:`submit`, retried with jittered exponential backoff
+    (~0.5 s base, doubling, capped at 30 s; jitter 0.5x..1.5x so a
+    herd of clients doesn't re-land in lockstep).
+
+    Retries cover exactly the failures that are safe and useful to
+    retry: transport errors (daemon not up yet, restarting after a
+    crash — connection refused) and the ``RETRYABLE`` reject codes
+    (``queue_full``, ``draining``).  Everything else — bad request,
+    failed job — returns/raises immediately.  Pass a ``job_key`` to
+    make the retries idempotent by contract: a retry that lands
+    after the original was admitted joins the SAME job, and one that
+    lands after a daemon crash is answered from the journal record
+    instead of re-running."""
+    import random
+    import time
+
+    attempt = 0
+    while True:
+        try:
+            resp = submit(socket_path, spec, priority=priority,
+                          timeout=timeout, want_trace=want_trace,
+                          trace_context=trace_context,
+                          job_key=job_key)
+        except ServeError as exc:
+            if attempt >= retries:
+                raise
+            reason = str(exc)
+        else:
+            code = (resp.get("error") or {}).get("code")
+            if resp.get("ok") or code not in RETRYABLE \
+                    or attempt >= retries:
+                return resp
+            reason = code
+        delay = min(30.0, 0.5 * (2 ** attempt))
+        delay *= 0.5 + random.random()
+        attempt += 1
+        print(f"[racon_tpu::submit] retryable failure ({reason}); "
+              f"attempt {attempt}/{retries} in {delay:.1f}s",
+              file=sys.stderr)
+        time.sleep(delay)
 
 
 def status(socket_path: str, timeout: float = 30.0) -> dict:
@@ -182,10 +235,11 @@ def spec_from_opts(opts: dict, inputs, tenant: str = None) -> dict:
 
 
 def _split_serve_flags(argv):
-    """Pull --socket/--priority/--tenant/--trace-context out of the
-    argv so the rest parses with the unchanged one-shot
-    ``cli.parse_args``."""
+    """Pull --socket/--priority/--tenant/--trace-context/--job-key/
+    --retry out of the argv so the rest parses with the unchanged
+    one-shot ``cli.parse_args``."""
     socket_path, priority, tenant, trace_context = None, 0, None, None
+    job_key, retry = None, 0
     rest = []
     i = 0
     while i < len(argv):
@@ -210,17 +264,28 @@ def _split_serve_flags(argv):
             trace_context = argv[i] if i < len(argv) else None
         elif a.startswith("--trace-context="):
             trace_context = a.split("=", 1)[1]
+        elif a == "--job-key":
+            i += 1
+            job_key = argv[i] if i < len(argv) else None
+        elif a.startswith("--job-key="):
+            job_key = a.split("=", 1)[1]
+        elif a == "--retry":
+            i += 1
+            retry = int(argv[i]) if i < len(argv) else 0
+        elif a.startswith("--retry="):
+            retry = int(a.split("=", 1)[1])
         else:
             rest.append(a)
         i += 1
-    return socket_path, priority, tenant, trace_context, rest
+    return (socket_path, priority, tenant, trace_context, job_key,
+            retry, rest)
 
 
 def main_submit(argv) -> int:
     from racon_tpu import cli
 
-    socket_path, priority, tenant, trace_context, rest = \
-        _split_serve_flags(argv)
+    socket_path, priority, tenant, trace_context, job_key, retry, \
+        rest = _split_serve_flags(argv)
     if not socket_path:
         print("[racon_tpu::submit] error: --socket PATH is required!",
               file=sys.stderr)
@@ -231,11 +296,11 @@ def main_submit(argv) -> int:
               file=sys.stderr)
         return 1
     try:
-        resp = submit(socket_path,
-                      spec_from_opts(opts, inputs, tenant=tenant),
-                      priority=priority,
-                      want_trace=bool(opts["trace"]),
-                      trace_context=trace_context)
+        resp = submit_with_retry(
+            socket_path, spec_from_opts(opts, inputs, tenant=tenant),
+            priority=priority, retries=max(0, retry),
+            want_trace=bool(opts["trace"]),
+            trace_context=trace_context, job_key=job_key)
     except ServeError as exc:
         print(f"[racon_tpu::submit] error: {exc}", file=sys.stderr)
         return 1
@@ -287,7 +352,7 @@ def main_submit(argv) -> int:
 
 
 def main_status(argv) -> int:
-    socket_path, _, _, _, rest = _split_serve_flags(argv)
+    socket_path, _, _, _, _, _, rest = _split_serve_flags(argv)
     as_json = "--json" in rest
     rest = [a for a in rest if a != "--json"]
     if not socket_path or rest:
@@ -311,6 +376,15 @@ def main_status(argv) -> int:
     print(f"queue       {q.get('queue_depth')}/{q.get('max_queue')} "
           f"queued, {len(q.get('running', []))}/{q.get('max_jobs')} "
           f"running, {q.get('completed')} completed")
+    j = doc.get("journal") or {}
+    if j.get("enabled"):
+        print(f"journal     {j.get('depth')} record(s) "
+              f"({j.get('bytes')} B) at {j.get('path')}")
+    rec = doc.get("recovered") or {}
+    if any(rec.get(k) for k in ("requeued", "completed", "failed")):
+        print(f"recovered   {rec.get('requeued', 0)} requeued, "
+              f"{rec.get('completed', 0)} completed from record, "
+              f"{rec.get('failed', 0)} failed")
     tenants = q.get("tenants") or {}
     if tenants:
         from racon_tpu.obs import export

@@ -84,6 +84,22 @@ def _detail(ev: dict) -> str:
     if kind == "drain":
         return (f"queued={ev.get('queued', 0)} "
                 f"running={ev.get('running', 0)}")
+    if kind == "checkpoint":
+        return f"windows={ev.get('n_windows', '?')}"
+    if kind == "dedup":
+        return (f"job_key={ev.get('job_key', '?')} "
+                + ("answered from record" if ev.get("recorded")
+                   else "joined live job"))
+    if kind == "recover":
+        return (f"job_key={ev.get('job_key', '?')} "
+                f"checkpoint_windows="
+                f"{ev.get('checkpoint_windows', 0)} "
+                f"from={ev.get('recovered_from', '?')}")
+    if kind == "recovery":
+        return (f"records={ev.get('records', 0)} "
+                f"completed={ev.get('completed', 0)} "
+                f"requeued={ev.get('requeued', 0)} "
+                f"failed={ev.get('failed', 0)}")
     return ""
 
 

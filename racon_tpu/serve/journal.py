@@ -1,0 +1,202 @@
+"""Write-ahead job journal: the serve tier's crash-safe record (r17).
+
+The flight recorder (racon_tpu/obs/flight.py) answers "what was the
+daemon doing" after the fact; it cannot bring the work back — a
+crashed daemon lost its queue and every in-flight job.  The journal
+promotes the flight-event schema from forensics to a write-ahead
+log: every job-state transition is appended to an fsync'd on-disk
+record BEFORE the daemon acts on it, so a restarted daemon replays
+the file (racon_tpu/serve/recover.py) and requeues what was
+interrupted.
+
+File format — append-only, length-prefixed JSON records, the same
+framing the wire protocol uses (racon_tpu/serve/protocol.py)::
+
+    +----------------+----------------------+
+    | 4 bytes, u32BE |  <length> JSON bytes |
+    +----------------+----------------------+ ...repeated
+
+Each record is a flight-event-shaped object (``kind``/``t``/``job``/
+``tenant`` + kind-specific fields) plus the journal envelope
+(``seq``, ``pid`` — records from several daemon incarnations share
+one file and are told apart by pid).  The first record of every
+incarnation is ``journal_open`` carrying ``schema:
+"racon-tpu-journal-v1"``.  Record kinds written by the serve tier:
+
+* ``admit``      — full job spec + ``job_key`` + priority/tenant/
+  trace id + the calibration-epoch snapshot the job is pinned to
+  (racon_tpu/utils/calibrate.epoch_snapshot)
+* ``start``      — a worker popped the job
+* ``checkpoint`` — one committed POA megabatch demux: the completed
+  window ordinals with their consensus bytes (b64) and polish flags,
+  so resume skips recompute AND stays byte-identical (the windows
+  adopt like speculative results — see TPUPolisher)
+* ``done``       — terminal success, carrying the full result frame
+  body (fasta_b64 + report) so a duplicate idempotent submit after
+  restart is answered from the record instead of re-running
+* ``error``      — terminal failure with the structured error
+* ``recovery``   — a restarted daemon's replay summary
+
+Every job carries a ``job_key`` — client-supplied (``submit
+--job-key``, idempotence across client retries) or daemon-minted
+(``auto-<pid>-<id>``) — and replay merges records ACROSS
+incarnations by that key, so a job requeued after crash N and
+crashed again at N+1 resumes at N+2 with the union of its
+checkpoints.
+
+Durability contract: ``append`` returns only after write+flush+
+fsync (``RACON_TPU_JOURNAL_FSYNC=0`` trades the fsync away for
+throughput).  ``scan`` tolerates a torn tail — a crash mid-append
+loses at most the record being written, never the file.  Timestamps
+are wall-clock (``obs.trace.wall_now``): journal records are cross-process
+identifiers read by a LATER process, so the per-process trace epoch
+the flight ring uses would not correlate.
+
+Knobs (provenance.KNOWN_KNOBS): ``RACON_TPU_JOURNAL`` ("0"
+disables — the daemon then behaves exactly as before r17),
+``RACON_TPU_JOURNAL_DIR`` (default: the socket's directory),
+``RACON_TPU_JOURNAL_FSYNC``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+
+from racon_tpu.obs import faultinject
+from racon_tpu.obs.trace import wall_now
+
+SCHEMA = "racon-tpu-journal-v1"
+
+_LEN = struct.Struct(">I")
+#: refuse records past this size on scan (a torn length prefix must
+#: not make replay try to allocate gigabytes)
+RECORD_MAX = 1 << 30
+
+
+def enabled() -> bool:
+    return os.environ.get("RACON_TPU_JOURNAL", "1") != "0"
+
+
+def journal_path(socket_path: str) -> str:
+    """Where the journal for a daemon on ``socket_path`` lives:
+    ``<socket>.journal`` beside the socket (or under
+    ``RACON_TPU_JOURNAL_DIR``) — so a restart on the same socket
+    finds the previous incarnation's record with zero config."""
+    d = os.environ.get("RACON_TPU_JOURNAL_DIR") \
+        or os.path.dirname(os.path.abspath(socket_path))
+    return os.path.join(d, os.path.basename(socket_path) + ".journal")
+
+
+def scan(path: str):
+    """Read every intact record -> ``(records, truncated)``.
+
+    A torn tail (partial prefix, short body, or non-JSON bytes — the
+    shapes a SIGKILL mid-append leaves) ends the scan cleanly with
+    ``truncated=True``; everything before it is returned."""
+    records = []
+    try:
+        f = open(path, "rb")
+    except OSError:
+        return records, False
+    with f:
+        while True:
+            head = f.read(_LEN.size)
+            if not head:
+                return records, False
+            if len(head) < _LEN.size:
+                return records, True
+            (n,) = _LEN.unpack(head)
+            if n > RECORD_MAX:
+                return records, True
+            body = f.read(n)
+            if len(body) < n:
+                return records, True
+            try:
+                rec = json.loads(body)
+            except ValueError:
+                return records, True
+            if isinstance(rec, dict):
+                records.append(rec)
+
+
+class JobJournal:
+    """One daemon incarnation's append handle.  All methods are
+    thread-safe; :func:`append` is called from the admission path,
+    the worker loop and the polisher's checkpoint callback
+    concurrently."""
+
+    def __init__(self, path: str, prior_records: int = 0):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(path, "ab")
+        self._fsync = os.environ.get(
+            "RACON_TPU_JOURNAL_FSYNC", "1") != "0"
+        self._seq = 0
+        self._prior = prior_records
+        self._last_fsync_t = None
+        self.append("journal_open", schema=SCHEMA,
+                    fsync=self._fsync)
+
+    def append(self, kind: str, job=None, **fields) -> None:
+        """Durably append one record.  Returns only after the bytes
+        are flushed (+fsync'd unless RACON_TPU_JOURNAL_FSYNC=0) —
+        callers rely on write-AHEAD ordering: the record survives
+        any crash that happens after this returns."""
+        rec = {"kind": kind, "t": round(wall_now(), 6),
+               "pid": os.getpid()}
+        if job is not None:
+            rec["job"] = int(job)
+        for k, v in fields.items():
+            if v is not None:
+                rec[k] = v
+        faultinject.hit("journal-write")
+        with self._lock:
+            self._seq += 1
+            # seq assigned under the lock so file order and seq
+            # order agree
+            rec["seq"] = self._seq
+            payload = json.dumps(
+                rec, separators=(",", ":")).encode()
+            self._f.write(_LEN.pack(len(payload)) + payload)
+            self._f.flush()
+            if self._fsync:
+                os.fsync(self._f.fileno())
+                self._last_fsync_t = wall_now()
+
+    def stats(self) -> dict:
+        """The ``health``/``status`` journal block: path, record
+        depth (prior incarnations + this one) and fsync recency."""
+        with self._lock:
+            try:
+                size = os.fstat(self._f.fileno()).st_size
+            except OSError:
+                size = None
+            return {
+                "enabled": True,
+                "path": self.path,
+                "depth": self._prior + self._seq,
+                "appended": self._seq,
+                "bytes": size,
+                "fsync": self._fsync,
+                "last_fsync_t": (round(self._last_fsync_t, 3)
+                                 if self._last_fsync_t else None),
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.flush()
+                if self._fsync:
+                    os.fsync(self._f.fileno())
+            except OSError:
+                pass
+            try:
+                self._f.close()
+            except OSError:
+                pass

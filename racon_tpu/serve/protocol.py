@@ -93,6 +93,26 @@ Decision-plane ops (r16, racon_tpu/obs/decision.py + calhealth.py):
   this one frame.
 * ``metrics`` / ``watch`` frames also carry the ``calhealth``
   summary, so the ``top`` drift column needs no extra round trip.
+
+Durability (r17, racon_tpu/serve/journal.py + recover.py):
+
+* ``submit`` may carry ``job_key`` (same charset/length rule as
+  ``trace_context``; client flag ``--job-key``): the client's
+  idempotence key.  A duplicate submit with the same key joins the
+  live job (one run, every duplicate gets the same response), and a
+  duplicate AFTER completion — including after a daemon crash and
+  restart — is answered from the write-ahead journal's recorded
+  result without re-running.  A malformed value is ``bad_request``.
+* ``health`` / ``status`` responses carry a ``journal`` block (the
+  write-ahead journal's ``enabled``/``path``/``depth``/``bytes``/
+  ``fsync``/``last_fsync_t``) and the restart-recovery summary
+  (``health``: ``recovered_jobs`` + ``recovery``; ``status``:
+  ``recovered``) so an operator can verify durability is on and see
+  what a restart replayed.
+* The journal file itself (``<socket>.journal``) uses THIS module's
+  length-prefixed JSON framing, one record per frame — see
+  racon_tpu/serve/journal.py for the record schema
+  (``racon-tpu-journal-v1``) and ``RACON_TPU_JOURNAL*`` knobs.
 """
 
 from __future__ import annotations

@@ -48,6 +48,7 @@ from typing import Callable, Optional
 from racon_tpu.obs import REGISTRY
 from racon_tpu.obs import context as obs_context
 from racon_tpu.obs import decision as obs_decision
+from racon_tpu.obs import faultinject
 from racon_tpu.obs import flight as obs_flight
 from racon_tpu.obs import trace as obs_trace
 
@@ -120,6 +121,17 @@ class Job:
         self.priority = priority
         self.estimate = estimate
         self.tenant = tenant
+        # durability plane (r17, all None/unset when the journal is
+        # off): the idempotence key, the write-ahead journal handle
+        # the session's checkpoint callback appends through, the
+        # replayed resume payload ({"windows": ..., "calib": ...}),
+        # the admission-time calibration-epoch snapshot, and the
+        # dead incarnation's "<pid>:<id>" this job was requeued from
+        self.job_key: Optional[str] = None
+        self.journal = None
+        self.resume: Optional[dict] = None
+        self.calib: Optional[dict] = None
+        self.recovered_from: Optional[str] = None
         # the job's trace id is fixed AT ADMISSION: a caller-supplied
         # wire trace context (r15) wins, else the deterministic
         # per-process id — so the admit flight event, the worker's
@@ -158,6 +170,13 @@ class JobScheduler:
         self._seq = itertools.count()
         self._ids = itertools.count(1)
         self._running: dict = {}         # job_id -> Job
+        # idempotence plane (r17): live jobs by key (duplicate keyed
+        # submit rendezvous on the SAME Job), terminal outcomes by
+        # key (duplicate after completion/restart answers from the
+        # record), and the write-ahead journal (None = disabled)
+        self._by_key: dict = {}          # job_key -> live Job
+        self._completed_by_key: dict = {}  # job_key -> result body
+        self._journal = None
         self._paused = False
         self._draining = False
         self._stopped = False
@@ -169,18 +188,73 @@ class JobScheduler:
         for t in self._workers:
             t.start()
 
+    # -- durability plane (r17) ----------------------------------------
+
+    def attach_journal(self, journal) -> None:
+        """Wire the write-ahead journal into the admission and worker
+        paths.  The server attaches it BEFORE binding the socket, so
+        no submission can race an unjournaled window."""
+        self._journal = journal
+
+    def preload_completed(self, results: dict) -> None:
+        """Seed the idempotence index with terminal outcomes replayed
+        from a previous incarnation's journal (job_key -> result
+        frame body): a duplicate keyed submit is answered from the
+        record instead of re-running."""
+        with self._cond:
+            self._completed_by_key.update(results)
+
+    def _journal_append(self, kind: str, **fields) -> None:
+        """Best-effort journal write for the worker path: a full disk
+        must fail the JOURNAL (counted, visible in health), not the
+        job that already ran."""
+        if self._journal is None:
+            return
+        try:
+            self._journal.append(kind, **fields)
+        except OSError:
+            REGISTRY.add("serve_journal_errors")
+
+    def _finished_job(self, job_key: str, result: dict) -> Job:
+        """A pre-finished Job wrapping a recorded terminal outcome —
+        what a duplicate keyed submit rendezvous on."""
+        job = Job(int(result.get("job_id") or 0), None, 0, None)
+        job.job_key = job_key
+        job.finish(dict(result))
+        return job
+
+    def _dedup_lookup(self, job_key: str) -> Optional[Job]:
+        """Under ``_cond``: the Job a duplicate keyed submit should
+        join, or None if the key is new."""
+        done = self._completed_by_key.get(job_key)
+        if done is not None:
+            return self._finished_job(job_key, done)
+        return self._by_key.get(job_key)
+
     # -- admission -----------------------------------------------------
 
     def submit(self, spec: dict, priority: int = 0,
-               trace_context: str = None) -> Job:
+               trace_context: str = None, job_key: str = None,
+               resume: dict = None,
+               recovered_from: str = None) -> Job:
         """Admit a job or raise :class:`RejectError`.  Never blocks on
         queue capacity — backpressure is an immediate structured
         reject, so a full server answers in microseconds.
         ``trace_context`` is the caller's wire trace id (r15): the
         job adopts it as its trace id, so forensics from every daemon
-        a logical request touched stitch on one id."""
+        a logical request touched stitch on one id.
+
+        r17 durability: ``job_key`` is the client's idempotence key —
+        a duplicate submit joins the live job or is answered from the
+        recorded outcome, never re-run.  ``resume`` /
+        ``recovered_from`` are recovery-internal
+        (racon_tpu/serve/recover.py): the replayed megabatch
+        checkpoints + calibration pin of an interrupted job being
+        requeued from a dead incarnation."""
         try:
-            return self._submit(spec, priority, trace_context)
+            return self._submit(spec, priority, trace_context,
+                                job_key=job_key, resume=resume,
+                                recovered_from=recovered_from)
         except RejectError as exc:
             obs_flight.FLIGHT.record(
                 "reject",
@@ -193,13 +267,34 @@ class JobScheduler:
             raise
 
     def _submit(self, spec: dict, priority: int,
-                trace_context: str = None) -> Job:
+                trace_context: str = None, job_key: str = None,
+                resume: dict = None,
+                recovered_from: str = None) -> Job:
         if trace_context is not None and \
                 not obs_context.valid_trace_id(trace_context):
             raise RejectError({
                 "code": "bad_request",
                 "reason": "trace_context must be 1..128 chars of "
                           "[A-Za-z0-9._:-] starting alphanumeric"})
+        if job_key is not None and \
+                not obs_context.valid_trace_id(job_key):
+            raise RejectError({
+                "code": "bad_request",
+                "reason": "job_key must be 1..128 chars of "
+                          "[A-Za-z0-9._:-] starting alphanumeric"})
+        # idempotence fast path BEFORE input validation: a duplicate
+        # of a recorded job must be answered from the record even if
+        # its inputs were cleaned up since the original ran
+        if job_key is not None:
+            with self._cond:
+                hit = self._dedup_lookup(job_key)
+            if hit is not None:
+                REGISTRY.add("serve_dedup_hits")
+                obs_flight.FLIGHT.record(
+                    "dedup", job=hit.id, job_key=job_key,
+                    trace_id=trace_context,
+                    recorded=hit.done.is_set())
+                return hit
         for key in ("sequences", "overlaps", "targets"):
             path = spec.get(key)
             if not isinstance(path, str):
@@ -249,9 +344,50 @@ class JobScheduler:
                     "queue_depth": len(self._heap),
                     "max_queue": self.max_queue,
                     "running": len(self._running)})
+            if job_key is not None:
+                # re-check under the admission lock: two concurrent
+                # NEW submits with the same key must admit once
+                hit = self._dedup_lookup(job_key)
+                if hit is not None:
+                    REGISTRY.add("serve_dedup_hits")
+                    obs_flight.FLIGHT.record(
+                        "dedup", job=hit.id, job_key=job_key,
+                        trace_id=trace_context,
+                        recorded=hit.done.is_set())
+                    return hit
             job = Job(next(self._ids), spec, priority, estimate,
                       tenant=tenant, trace_context=trace_context)
             job.t_submit = obs_trace.now()
+            job.resume = resume
+            job.recovered_from = recovered_from
+            job.journal = self._journal
+            if self._journal is not None:
+                # every journaled job has a key — client-supplied or
+                # daemon-minted — because replay merges records
+                # across incarnations by key
+                job.job_key = job_key or \
+                    f"auto-{os.getpid()}-{job.id}"
+                # the calibration epoch the job is pinned to: a
+                # requeued job carries its ORIGINAL admission
+                # snapshot forward (byte-identity across restart),
+                # a fresh job snapshots now
+                if resume and isinstance(resume.get("calib"), dict):
+                    job.calib = resume["calib"]
+                else:
+                    from racon_tpu.utils import calibrate
+                    job.calib = calibrate.epoch_snapshot()
+                # write-AHEAD: the admit record is durable before the
+                # job is queued (a crash after this line replays it)
+                self._journal_append(
+                    "admit", job=job.id, job_key=job.job_key,
+                    spec=spec, priority=priority, tenant=tenant,
+                    trace_id=job.trace_id, calib=job.calib,
+                    recovered_from=recovered_from)
+            else:
+                job.job_key = job_key
+            if job.job_key:
+                self._by_key[job.job_key] = job
+            faultinject.hit("post-admit")
             heapq.heappush(self._heap, (-priority, next(self._seq),
                                         job))
             REGISTRY.add("serve_jobs_submitted")
@@ -311,6 +447,10 @@ class JobScheduler:
                 trace_id=job.trace_id,
                 queue_wait_s=(round(queue_wait, 6)
                               if queue_wait is not None else None))
+            if job.job_key:
+                self._journal_append("start", job=job.id,
+                                     job_key=job.job_key,
+                                     tenant=job.tenant)
             # the job is a device-executor tenant for its lifetime:
             # its megabatches fuse with other registered tenants',
             # under the executor's DRR fairness + in-flight quota
@@ -369,9 +509,25 @@ class JobScheduler:
                     predicted_s=round(float(predicted), 6),
                     measured_s=round(exec_wall, 6),
                     ratio=round(exec_wall / predicted, 6))
+            # terminal record BEFORE the client rendezvous: once the
+            # caller sees the result, any crash must replay it from
+            # the journal, not re-run the job
+            faultinject.hit("pre-done-record")
+            if job.job_key:
+                if result.get("ok"):
+                    self._journal_append("done", job=job.id,
+                                         job_key=job.job_key,
+                                         result=result)
+                else:
+                    self._journal_append("error", job=job.id,
+                                         job_key=job.job_key,
+                                         error=result.get("error"))
             with self._cond:
                 del self._running[job.id]
                 self._completed += 1
+                if job.job_key:
+                    self._completed_by_key[job.job_key] = result
+                    self._by_key.pop(job.job_key, None)
                 REGISTRY.set("serve_running", len(self._running))
                 self._cond.notify_all()
             job.finish(result)

@@ -55,7 +55,9 @@ from racon_tpu.obs import context as obs_context
 from racon_tpu.obs import decision as obs_decision
 from racon_tpu.obs import flight as obs_flight
 from racon_tpu.obs import trace as obs_trace
+from racon_tpu.serve import journal as serve_journal
 from racon_tpu.serve import protocol
+from racon_tpu.serve import recover
 from racon_tpu.serve.scheduler import JobScheduler, RejectError
 from racon_tpu.serve.session import run_job
 
@@ -80,6 +82,12 @@ class PolishServer:
         self._last_activity = self._t_start
         self._lock = threading.Lock()
         self._exit_reason = "drain"
+        # durability plane (r17): the write-ahead journal handle
+        # (opened in serve_forever AFTER the takeover check, so a
+        # refused second daemon never writes into the live daemon's
+        # journal) and the recovery summary for health/status
+        self._journal = None
+        self.recovered = {"requeued": 0, "failed": 0, "completed": 0}
         # request-scoped forensics (r14): keep a bounded per-job
         # trace slice for `submit --trace` / `inspect`, and dump the
         # flight ring if any thread dies with an unhandled exception
@@ -129,10 +137,17 @@ class PolishServer:
                 "bad_request",
                 "trace_context must be 1..128 chars of "
                 "[A-Za-z0-9._:-] starting alphanumeric")
+        job_key = req.get("job_key")
+        if job_key is not None and \
+                not obs_context.valid_trace_id(job_key):
+            return protocol.error_frame(
+                "bad_request",
+                "job_key must be 1..128 chars of "
+                "[A-Za-z0-9._:-] starting alphanumeric")
         try:
             job = self.scheduler.submit(
                 spec, priority=int(req.get("priority", 0)),
-                trace_context=trace_context)
+                trace_context=trace_context, job_key=job_key)
         except RejectError as exc:
             return {"ok": False, "error": exc.error}
         job.done.wait()
@@ -160,6 +175,8 @@ class PolishServer:
             "uptime_s": round(obs_trace.now() - self._t_start, 3),
             "draining": self.scheduler.draining,
             "queue": self.scheduler.snapshot(),
+            "journal": self._journal_doc(),
+            "recovered": dict(self.recovered),
             "idle_timeout_s": self.idle_timeout,
             "registry": REGISTRY.snapshot(),
             "provenance": provenance.environment(probe=False),
@@ -272,7 +289,16 @@ class PolishServer:
             "flight_ring_depth": obs_flight.FLIGHT.stats()["size"],
             "fusion_queue_depth":
                 device_executor.get_executor().pending_units(),
+            "journal": self._journal_doc(),
+            "recovered_jobs": self.recovered["requeued"],
+            "recovery": dict(self.recovered),
         }
+
+    def _journal_doc(self) -> dict:
+        """The write-ahead journal's health block (r17)."""
+        if self._journal is not None:
+            return self._journal.stats()
+        return {"enabled": False}
 
     def _handle_watch(self, conn, req: dict) -> None:
         """Stream telemetry frames on this connection (the one
@@ -383,6 +409,79 @@ class PolishServer:
             return (obs_trace.now() - self._last_activity
                     > self.idle_timeout)
 
+    # -- durability (r17) ----------------------------------------------
+
+    def _peer_alive(self):
+        """Probe the socket's current owner with a real ``health``
+        frame: ``True`` = answered (alive), ``False`` = connection
+        refused (dead — the socket is stale), ``None`` = ambiguous
+        (connected but no valid frame; the caller refuses takeover
+        rather than orphan a wedged-but-alive daemon's queue)."""
+        probe = socket.socket(socket.AF_UNIX)
+        probe.settimeout(5.0)
+        try:
+            probe.connect(self.socket_path)
+        except ConnectionRefusedError:
+            return False
+        except OSError:
+            return None
+        try:
+            protocol.send_frame(probe, {"op": "health"})
+            resp = protocol.recv_frame(probe)
+            return True if isinstance(resp, dict) else None
+        except (protocol.ProtocolError, OSError):
+            return None
+        finally:
+            try:
+                probe.close()
+            except OSError:
+                pass
+
+    def _recover(self) -> None:
+        """Open the write-ahead journal and replay any previous
+        incarnation's record: terminal outcomes preload the
+        scheduler's idempotence index (duplicate keyed submits answer
+        from the record), interrupted jobs requeue through NORMAL
+        admission carrying their megabatch checkpoints, and the
+        replay summary is journaled + flight-recorded.  No-op with
+        ``RACON_TPU_JOURNAL=0`` — the daemon then behaves exactly as
+        before r17."""
+        if not serve_journal.enabled():
+            return
+        path = serve_journal.journal_path(self.socket_path)
+        records, truncated = serve_journal.scan(path)
+        plan = recover.replay(records)
+        self._journal = serve_journal.JobJournal(
+            path, prior_records=len(records))
+        self.scheduler.attach_journal(self._journal)
+        self.scheduler.preload_completed(plan["completed"])
+        out = recover.requeue(self.scheduler, plan,
+                              journal=self._journal,
+                              flight=obs_flight.FLIGHT)
+        self.recovered = {
+            "requeued": out["requeued"],
+            "failed": plan["stats"]["failed"] + out["failed"],
+            "completed": plan["stats"]["completed"],
+        }
+        REGISTRY.set("serve_recovered_jobs", out["requeued"])
+        if records:
+            self._journal.append(
+                "recovery", stats=plan["stats"],
+                requeued=out["requeued"],
+                requeue_failed=out["failed"],
+                truncated=truncated or None)
+            obs_flight.FLIGHT.record(
+                "recovery", records=len(records),
+                completed=plan["stats"]["completed"],
+                requeued=out["requeued"],
+                failed=self.recovered["failed"])
+            eprint(f"[racon_tpu::serve] journal replay ({path}): "
+                   f"{len(records)} record(s) -> "
+                   f"{plan['stats']['completed']} completed, "
+                   f"{out['requeued']} requeued, "
+                   f"{self.recovered['failed']} failed"
+                   + (" (torn tail dropped)" if truncated else ""))
+
     # -- main loop -----------------------------------------------------
 
     def serve_forever(self) -> int:
@@ -390,20 +489,35 @@ class PolishServer:
         # server-start calibration state (see module docstring)
         os.environ["RACON_TPU_CALIB_FREEZE"] = "1"
         if os.path.exists(self.socket_path):
-            # a stale socket from a dead server blocks bind();
-            # a LIVE server answers a probe connect, and replacing
-            # it would silently orphan its queue
-            probe = socket.socket(socket.AF_UNIX)
-            try:
-                probe.connect(self.socket_path)
-            except OSError:
-                os.unlink(self.socket_path)
-            else:
+            # takeover decision (r17): unlink ONLY a provably dead
+            # peer.  A bare connect() can succeed against a wedged
+            # listener backlog, so the liveness proof is a real
+            # health-frame round trip; anything short of a refused
+            # connection or a valid answer refuses takeover rather
+            # than orphan a live daemon's queue.
+            alive = self._peer_alive()
+            if alive:
                 eprint(f"[racon_tpu::serve] error: a live server "
-                       f"already owns {self.socket_path}")
+                       f"already owns {self.socket_path} "
+                       f"(health-frame probe answered); refusing "
+                       f"to take over")
                 return 1
-            finally:
-                probe.close()
+            if alive is None:
+                eprint(f"[racon_tpu::serve] error: cannot prove the "
+                       f"owner of {self.socket_path} dead (probe "
+                       f"connected but no health frame answered); "
+                       f"refusing to take over — remove the socket "
+                       f"manually if the process is gone")
+                return 1
+            eprint(f"[racon_tpu::serve] stale socket "
+                   f"{self.socket_path}: previous owner is dead, "
+                   f"taking over")
+            os.unlink(self.socket_path)
+        # journal + crash recovery AFTER the takeover check (a
+        # refused second daemon must never touch the live daemon's
+        # journal) and BEFORE bind (requeued jobs re-admit before
+        # any new submission can race them)
+        self._recover()
         self._sock = socket.socket(socket.AF_UNIX)
         self._sock.bind(self.socket_path)
         self._sock.listen(16)
@@ -486,6 +600,8 @@ class PolishServer:
             except OSError as exc:
                 eprint(f"[racon_tpu::serve] flight dump failed: "
                        f"{exc}")
+        if self._journal is not None:
+            self._journal.close()
         eprint(f"[racon_tpu::serve] drained "
                f"({snap['completed']} job(s) served); bye")
 

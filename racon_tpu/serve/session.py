@@ -62,6 +62,59 @@ def _resolve_options(spec: dict) -> dict:
     return opts
 
 
+def _wire_durability(polisher, job) -> None:
+    """r17: connect the polisher's three durability hooks to the
+    job's journal/recovery state (all no-ops when the journal is
+    off):
+
+    * calibration pin — split rates come from the job's ADMISSION
+      epoch snapshot, not the live calibration file, so a resumed
+      job computes the same device/CPU split the interrupted run
+      did even if calibration moved on disk in between;
+    * resume windows — megabatch checkpoints replayed from a dead
+      incarnation's journal, adopted like speculative results
+      (byte-for-byte what that incarnation committed);
+    * checkpoint callback — each committed megabatch demux appends
+      one checkpoint record.  Best-effort: a full disk degrades
+      durability (counted in ``serve_journal_errors``), never fails
+      the job that just committed.
+    """
+    calib = getattr(job, "calib", None)
+    if isinstance(calib, dict) and isinstance(calib.get("data"),
+                                              dict):
+        polisher._calib_pin = calib["data"]
+    resume = getattr(job, "resume", None)
+    if isinstance(resume, dict):
+        windows = {}
+        for k, v in (resume.get("windows") or {}).items():
+            try:
+                cons = base64.b64decode(v[0]) if v[0] else None
+                windows[int(k)] = (cons, bool(v[1]))
+            except (ValueError, TypeError, IndexError):
+                continue   # torn checkpoint entry: recompute it
+        if windows:
+            polisher._resume_windows = windows
+    journal = getattr(job, "journal", None)
+    if journal is None or not getattr(job, "job_key", None):
+        return
+
+    def _checkpoint(entries):
+        enc = {
+            str(i): [(base64.b64encode(cons).decode("ascii")
+                      if cons is not None else None), bool(ok)]
+            for i, cons, ok in entries}
+        try:
+            journal.append("checkpoint", job=job.id,
+                           job_key=job.job_key, windows=enc)
+        except OSError:
+            REGISTRY.add("serve_journal_errors")
+        obs_flight.FLIGHT.record(
+            "checkpoint", job=job.id, tenant=job.tenant,
+            trace_id=job.trace_id, n_windows=len(entries))
+
+    polisher._checkpoint_cb = _checkpoint
+
+
 def run_job(job) -> dict:
     """Execute one admitted job; returns the response frame body."""
     from racon_tpu.core.polisher import PolisherType, create_polisher
@@ -89,6 +142,7 @@ def run_job(job) -> dict:
             # other tenants' batches and enforce per-tenant fairness
             polisher._executor_tenant = getattr(job, "tenant",
                                                 "default")
+            _wire_durability(polisher, job)
             polisher.initialize()
             polished = polisher.polish(opts["drop_unpolished"])
         fasta = b"".join(b">" + s.name.encode() + b"\n" + s.data

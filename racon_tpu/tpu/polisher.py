@@ -31,6 +31,7 @@ from racon_tpu.core.window import WindowType
 from racon_tpu.obs import MetricAttr
 from racon_tpu.obs import calhealth as obs_calhealth
 from racon_tpu.obs import devutil as obs_devutil
+from racon_tpu.obs import faultinject
 from racon_tpu.obs import trace as obs_trace
 from racon_tpu.obs import decision as obs_decision
 
@@ -263,6 +264,24 @@ class TPUPolisher(Polisher):
         self.poa_spec_used = 0
         self.poa_spec_wasted = 0
         self.poa_split_detail = {}
+        # durability hooks (r17, racon_tpu/serve/session.py wires
+        # them for served jobs; standalone runs leave all three
+        # unset):
+        #   _checkpoint_cb  — called with [(ordinal, consensus, ok)]
+        #     after each committed POA megabatch demux (the
+        #     write-ahead journal's checkpoint record);
+        #   _resume_windows — {ordinal: (consensus|None, ok)} replayed
+        #     from a dead daemon's journal, adopted exactly like
+        #     speculative results (device-assigned windows only) so
+        #     resumed bytes equal uninterrupted bytes;
+        #   _calib_pin      — the job's admission-time calibration
+        #     snapshot (calibrate.epoch_snapshot()["data"]), piped
+        #     into every get_rates call so a resume after the machine
+        #     recalibrated still prices the SAME argmin split.
+        self._checkpoint_cb = None
+        self._resume_windows = None
+        self._calib_pin = None
+        self.poa_resumed_windows = 0
         from racon_tpu.utils.xla_cache import enable_compilation_cache
         enable_compilation_cache()
 
@@ -757,7 +776,7 @@ class TPUPolisher(Polisher):
             # pins for golden CI configs) -- racon_tpu/utils/calibrate
             r_dev, r_cpu, r_src = calibrate.get_rates(
                 "poa", n_dev, self.POA_DEV_US_PER_UNIT,
-                self.POA_CPU_US_PER_UNIT)
+                self.POA_CPU_US_PER_UNIT, pin=self._calib_pin)
             # price the CPU tail over the RESERVED-down worker count:
             # the host also runs the data plane (decode, routing,
             # stitching), so a full-worker rate overstated the tail
@@ -781,7 +800,7 @@ class TPUPolisher(Polisher):
         # with 0 rejects was unexplainable from the shipped record)
         sd_dev, sd_cpu, sd_src = calibrate.get_rates(
             "poa", n_dev, self.POA_DEV_US_PER_UNIT,
-            self.POA_CPU_US_PER_UNIT)
+            self.POA_CPU_US_PER_UNIT, pin=self._calib_pin)
         units = [unit_of[i] for i in eligible]
         depths = [len(self.windows[i].sequences) - 1 for i in eligible]
         total_u = sum(units) or 1.0
@@ -826,8 +845,13 @@ class TPUPolisher(Polisher):
         # RACON_TPU_STEAL (documented as run-to-run varying) every
         # spec result is used.
         spec_failed: List[int] = []
+        # the device-assigned set under the ORIGINAL cut: both the
+        # speculative results and the r17 journal-replayed checkpoint
+        # results below adopt ONLY inside it, so neither mechanism
+        # can move a window between engines
+        assigned = eligible if steal else eligible[:dev_left]
+        adopted_ckpt: List[tuple] = []
         if spec:
-            assigned = eligible if steal else eligible[:dev_left]
             resolved = [i for i in assigned if i in spec]
             for i in resolved:
                 cons, ok = spec[i]
@@ -835,10 +859,12 @@ class TPUPolisher(Polisher):
                     # device reject: CPU re-polish below, exactly as a
                     # stage-time dispatch of this window would have
                     spec_failed.append(i)
+                    adopted_ckpt.append((i, None, False))
                 else:
                     self.windows[i].consensus = cons
                     flags[i] = ok
                     self.poa_device_windows += 1
+                    adopted_ckpt.append((i, cons, ok))
             self.poa_spec_used = len(resolved)
             self.poa_spec_wasted = len(spec) - len(resolved)
             obs_decision.DECISIONS.record(
@@ -856,6 +882,49 @@ class TPUPolisher(Polisher):
                 f"{self.poa_spec_used}/{len(spec)} speculative "
                 f"window(s) adopted "
                 f"({self.poa_spec_wasted} recomputed on CPU)")
+
+        # resume from journaled checkpoints (r17): a restarted daemon
+        # replays the dead incarnation's committed megabatches into
+        # _resume_windows; they adopt exactly like speculative
+        # results — device-assigned windows only, split untouched —
+        # so the resumed run's bytes equal an uninterrupted run's by
+        # the same argument that pins the speculative path.  A
+        # ``None`` consensus replays a journaled device reject into
+        # the same CPU re-polish the original dispatch took.
+        resume = self._resume_windows
+        if resume:
+            aset = set(assigned)
+            resumed = [i for i in work if i in resume and i in aset]
+            for i in resumed:
+                cons, ok = resume[i]
+                if cons is None:
+                    spec_failed.append(i)
+                else:
+                    self.windows[i].consensus = cons
+                    flags[i] = bool(ok)
+                    self.poa_device_windows += 1
+            self.poa_resumed_windows = len(resumed)
+            self.metrics.set("poa_resumed_windows", len(resumed))
+            if resumed:
+                rs = set(resumed)
+                work = deque(i for i in work if i not in rs)
+                if steal or not n_workers:
+                    dev_left = len(work)
+                else:
+                    dev_left -= len(resumed)
+                obs_decision.DECISIONS.record(
+                    "poa_resume", used=len(resumed),
+                    replayed=len(resume))
+                self.logger.log(
+                    f"[racon_tpu::TPUPolisher::polish] poa resume: "
+                    f"{len(resumed)}/{len(resume)} checkpointed "
+                    f"window(s) adopted from the journal")
+        if adopted_ckpt and self._checkpoint_cb is not None:
+            # spec-adopted windows are committed now — journal them
+            # now, so a crash before the first megabatch still
+            # resumes them (resumed windows were already journaled
+            # by the incarnation that computed them)
+            self._checkpoint_cb(adopted_ckpt)
 
         def cpu_worker():
             while True:
@@ -890,6 +959,10 @@ class TPUPolisher(Polisher):
         def apply(idxs, collect, record=True):
             nonlocal mark
             results = collect()
+            # chaos site (r17): device results landed on the host but
+            # the demux below has not committed them — a kill here
+            # must replay this whole megabatch on restart
+            faultinject.hit("pre-demux")
             now = _now()
             u_batch = sum(unit_of[i] for i in idxs)
             if record:
@@ -909,13 +982,22 @@ class TPUPolisher(Polisher):
                 "poa.megabatch", mark, now, cat="poa",
                 args={"n": len(idxs), "recorded": bool(record)})
             mark = now
+            ckpt = []
             for i, (cons, ok) in zip(idxs, results):
                 if cons is None:
                     failed.append(i)
+                    ckpt.append((i, None, False))
                 else:
                     self.windows[i].consensus = cons
                     flags[i] = ok
                     self.poa_device_windows += 1
+                    ckpt.append((i, cons, ok))
+            if self._checkpoint_cb is not None:
+                # the megabatch is committed: journal it (r17).  The
+                # callback writes AFTER the commit above, so a crash
+                # between commit and journal merely replays one
+                # megabatch — never resumes uncommitted state.
+                self._checkpoint_cb(ckpt)
             self.logger.bar("[racon_tpu::TPUPolisher::polish] "
                             "generating consensus (device)")
 
@@ -942,11 +1024,18 @@ class TPUPolisher(Polisher):
                     apply(*pipe.popleft())
                 collect = engine.consensus_batch_async(
                     batch, self.trim, pool=self._pool)
+                # chaos site (r17): same exposure as the pipelined
+                # branch below — the megabatch is dispatched,
+                # nothing about it journaled yet
+                faultinject.hit("mid-megabatch")
                 apply(idxs, collect, record=False)
                 continue
             collect = engine.consensus_batch_async(batch, self.trim,
                                                    pool=self._pool)
             pipe.append((idxs, collect))
+            # chaos site (r17): a megabatch is in flight on the
+            # device, nothing about it journaled yet
+            faultinject.hit("mid-megabatch")
             while len(pipe) >= depth:
                 apply(*pipe.popleft())
         while pipe:
@@ -992,7 +1081,7 @@ class TPUPolisher(Polisher):
         dev_u = sum(u for _, u in recorded)
         _, _, _src = calibrate.get_rates(
             "poa", n_dev, self.POA_DEV_US_PER_UNIT,
-            self.POA_CPU_US_PER_UNIT)
+            self.POA_CPU_US_PER_UNIT, pin=self._calib_pin)
         if dev_u > 0 and meas["cpu_u"] > 0 and _src != "env":
             # env-pinned runs (CI, tests) never mutate the machine's
             # calibration cache
@@ -1230,7 +1319,7 @@ class TPUPolisher(Polisher):
         n_dev = len(self.mesh.devices)
         r_dev, r_cpu, r_src = calibrate.get_rates(
             "align", n_dev, float(self.DEV_NS_PER_ROW),
-            float(self.CPU_NS_PER_CELL))
+            float(self.CPU_NS_PER_CELL), pin=self._calib_pin)
         if r_src != "env":
             # the CPU rate calibrates as its own stage: the device
             # rate only stores on multi-chunk runs, and entangling the
@@ -1239,7 +1328,8 @@ class TPUPolisher(Polisher):
             # (RACON_TPU_RATE_ALIGN_{DEV,CPU} -- CI's golden configs,
             # tests/conftest.py) still pins BOTH rates above.
             r_cpu, _, _ = calibrate.get_rates(
-                "align_cpu", n_dev, float(self.CPU_NS_PER_CELL), 1.0)
+                "align_cpu", n_dev, float(self.CPU_NS_PER_CELL), 1.0,
+                pin=self._calib_pin)
         # CPU cost model: the native engine is WFA, O(d + s^2) in the
         # DISTANCE s, not O(d^2) full DP -- at 10-15% divergence that
         # is a ~100x difference, and the old d^2 model starved the CPU
@@ -1268,7 +1358,8 @@ class TPUPolisher(Polisher):
         # one contended host core (the 0.83x mega_ont leg)
         wfa_cap = self._wfa_emax_cap()
         r_wfa, _, _ = calibrate.get_rates(
-            "align_wfa", n_dev, float(self.WFA_DEV_NS_PER_STEP), 1.0)
+            "align_wfa", n_dev, float(self.WFA_DEV_NS_PER_STEP), 1.0,
+            pin=self._calib_pin)
 
         def dev_cost(i):
             d, o = pending[i]
@@ -1899,7 +1990,7 @@ class TPUPolisher(Polisher):
         from racon_tpu.utils import calibrate
         r_dev, _, _ = calibrate.get_rates(
             "align", n_dev, float(self.DEV_NS_PER_ROW),
-            float(self.CPU_NS_PER_CELL))
+            float(self.CPU_NS_PER_CELL), pin=self._calib_pin)
         units = float(sum(len(q) for q in queries))
         pred = calibrate.predict_chunk_wall("align", units, r_dev,
                                             n_dev)

@@ -72,21 +72,64 @@ def _machine_key(n_dev: int) -> str:
     return f"{plat}-{n_dev}dev-{os.cpu_count()}cpu-{_code_salt()}"
 
 
+def epoch_snapshot() -> dict:
+    """The persisted calibration state as one pinnable value:
+    ``{"epoch": <12-hex content hash or "none">, "data": <parsed
+    calibration.json or {}>}``.
+
+    The serve tier journals this AT ADMISSION (r17): a job admitted
+    under epoch A whose daemon crashes and restarts after the
+    machine recalibrated to epoch B must resume with A's rates —
+    same argmin split, same engine assignment, byte-identical FASTA.
+    The lifetime ``RACON_TPU_CALIB_FREEZE`` already pins rates
+    WITHIN one daemon life; the journaled snapshot extends the pin
+    across restarts, per job."""
+    import hashlib
+
+    path = _calib_path()
+    if path is None:
+        return {"epoch": "none", "data": {}}
+    with _lock:
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            data = json.loads(raw)
+        except Exception:
+            return {"epoch": "none", "data": {}}
+    return {"epoch": hashlib.sha1(raw).hexdigest()[:12],
+            "data": data if isinstance(data, dict) else {}}
+
+
 def get_rates(stage: str, n_dev: int, default_dev: float,
-              default_cpu: float) -> tuple:
+              default_cpu: float, pin: dict = None) -> tuple:
     """(dev_rate, cpu_rate, source) for a hybrid stage.  Stages in
     use: "poa" (us/cost-unit), "align" (banded device ns/row),
     "align_wfa" (wavefront device ns/e-step), "align_cpu" (host WFA
     ns/modeled-cell).  Precedence:
-    env pin > persisted calibration > defaults.  Reads the persisted
-    file on every call (it is tiny), so a multi-polish process adopts its own
+    env pin > per-job epoch pin > persisted calibration > defaults.
+    Reads the persisted file on every call (it is tiny), so a
+    multi-polish process adopts its own
     measurements as they land; within one polish each stage reads its
-    rates once, so a single run's split stays internally coherent."""
+    rates once, so a single run's split stays internally coherent.
+
+    ``pin`` is a calibration-file-shaped dict (the ``data`` of an
+    :func:`epoch_snapshot`): when it carries this machine+stage the
+    rates come from the pin, source ``"pinned"`` — the r17 per-job
+    epoch pin a recovered job resumes under.  Env pins still win:
+    golden CI configs must stay exactly what the env encodes."""
     env_dev = os.environ.get(f"RACON_TPU_RATE_{stage.upper()}_DEV")
     env_cpu = os.environ.get(f"RACON_TPU_RATE_{stage.upper()}_CPU")
     if env_dev and env_cpu:
         return (float(env_dev), float(env_cpu), "env")
     out = (default_dev, default_cpu, "default")
+    if isinstance(pin, dict):
+        try:
+            ent = pin.get(_machine_key(n_dev), {}).get(stage)
+        except AttributeError:
+            ent = None
+        if ent:
+            return (float(ent.get("dev", default_dev)),
+                    float(ent.get("cpu", default_cpu)), "pinned")
     if not os.environ.get("RACON_TPU_RECALIBRATE") and _calib_path():
         with _lock:
             try:

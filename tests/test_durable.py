@@ -1,0 +1,522 @@
+"""Durable serve tier (racon_tpu/serve/journal.py + recover.py +
+racon_tpu/obs/faultinject.py) — ISSUE 13.
+
+The contract under test, end-to-end on the CPU backend:
+
+* **journal mechanics** — length-prefixed records roundtrip through
+  ``scan``; a torn tail (SIGKILL mid-append) loses at most the
+  record being written, never the file.
+* **replay** — journal records fold into the recovery plan: terminal
+  jobs answer duplicates from the record, interrupted jobs carry the
+  union of their megabatch checkpoints across incarnations.
+* **crash recovery, byte-identical** — a daemon SIGKILL'd by the
+  deterministic fault harness (``RACON_TPU_FAULT=<site>:<nth>``) at
+  EVERY fault site mid-job, then restarted on the same socket +
+  journal, requeues the interrupted job and a keyed duplicate submit
+  returns EXACTLY the one-shot CLI's bytes — the r17 acceptance pin.
+* **idempotent job keys** — duplicate ``--job-key`` submits join the
+  live job (one run, same job id) and, after completion, answer from
+  the recorded result.
+* **stale-socket takeover** — a second daemon refuses a LIVE peer's
+  socket (health-frame probe answers) and takes over a dead one.
+* **off switch** — ``RACON_TPU_JOURNAL=0`` writes no journal and
+  returns bytes identical to the journaled daemon's.
+* **client retry** — ``submit_with_retry`` survives
+  connection-refused (daemon not up yet / restarting).
+
+Chaos runs pin ``RACON_TPU_POA_MEGABATCH=1`` so this small dataset
+produces two device megabatches (8 virtual devices x 1) — the
+mid-megabatch / pre-demux sites need a megabatch actually in flight,
+and recovery needs a committed checkpoint to resume from.
+"""
+
+import base64
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from racon_tpu.serve import client  # noqa: E402
+from racon_tpu.serve import journal as serve_journal  # noqa: E402
+from racon_tpu.serve import recover  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fixtures (the serve-suite pattern: short socket paths, pinned rates)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_tmp():
+    with tempfile.TemporaryDirectory(prefix="rtdur_",
+                                     dir="/tmp") as d:
+        yield d
+
+
+@pytest.fixture(scope="module")
+def dataset(serve_tmp):
+    from racon_tpu.tools import simulate
+
+    return simulate.simulate(os.path.join(serve_tmp, "data"),
+                             genome_len=8_000, coverage=5,
+                             read_len=800, seed=21, ont=True)
+
+
+def _serve_env(serve_tmp, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "RACON_TPU_CACHE_DIR": os.path.join(serve_tmp, "cache"),
+        "RACON_TPU_CLI_PREWARM": "0",
+        # pinned rates: the split (and therefore which windows are
+        # device-assigned and checkpointed) is identical across the
+        # killed run, the recovery run and the golden run
+        "RACON_TPU_RATE_POA_DEV": "0.30",
+        "RACON_TPU_RATE_POA_CPU": "2.0",
+        "RACON_TPU_RATE_ALIGN_DEV": "1100",
+        "RACON_TPU_RATE_ALIGN_CPU": "4.0",
+        "RACON_TPU_RATE_ALIGN_WFA_DEV": "700",
+        "RACON_TPU_RATE_ALIGN_WFA_CPU": "1.0",
+        # two device megabatches on this dataset (see module doc)
+        "RACON_TPU_POA_MEGABATCH": "1",
+    })
+    env.pop("RACON_TPU_TRACE", None)
+    env.pop("RACON_TPU_METRICS_JSON", None)
+    env.pop("RACON_TPU_FAULT", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+@pytest.fixture(scope="module")
+def golden(dataset, serve_tmp):
+    """One-shot CLI bytes — what every recovered job must match."""
+    reads, paf, draft = dataset
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "4", "-c", "1",
+         "--tpualigner-batches", "1", reads, paf, draft],
+        cwd=REPO_ROOT, capture_output=True,
+        env=_serve_env(serve_tmp), timeout=600)
+    assert run.returncode == 0, run.stderr.decode()
+    assert run.stdout.startswith(b">")
+    return run.stdout
+
+
+def _spec(dataset):
+    reads, paf, draft = dataset
+    return {"sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 4, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1}
+
+
+def _start_server(serve_tmp, name, args=(), extra_env=None,
+                  expect_fail=False):
+    sock_path = os.path.join(serve_tmp, name + ".sock")
+    log_path = os.path.join(serve_tmp, name + ".log")
+    log = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve",
+         "--socket", sock_path, *args],
+        cwd=REPO_ROOT, stdout=log, stderr=log,
+        env=_serve_env(serve_tmp, extra_env))
+    log.close()
+    if expect_fail:
+        return proc, sock_path, log_path
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                "server died at startup: " + open(log_path).read())
+        if os.path.exists(sock_path):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock_path)
+            except OSError:
+                pass
+            else:
+                return proc, sock_path, log_path
+            finally:
+                probe.close()
+        time.sleep(0.2)
+    proc.kill()
+    raise AssertionError("server socket never came up")
+
+
+def _stop(proc, sock_path):
+    if proc.poll() is None:
+        try:
+            client.admin(sock_path, "shutdown")
+        except client.ServeError:
+            proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# journal + replay + fault-harness mechanics (no daemon)
+# ---------------------------------------------------------------------------
+
+def test_journal_roundtrip_and_torn_tail(tmp_path):
+    path = str(tmp_path / "j.journal")
+    j = serve_journal.JobJournal(path)
+    j.append("admit", job=1, job_key="k1", spec={"x": 1})
+    j.append("checkpoint", job=1, job_key="k1",
+             windows={"0": ["YQ==", True]})
+    j.close()
+    records, truncated = serve_journal.scan(path)
+    assert not truncated
+    assert [r["kind"] for r in records] == ["journal_open", "admit",
+                                            "checkpoint"]
+    assert records[0]["schema"] == serve_journal.SCHEMA
+    assert [r["seq"] for r in records] == [1, 2, 3]
+    assert records[1]["spec"] == {"x": 1}
+
+    # torn tail: a partial record (SIGKILL mid-append) drops cleanly
+    with open(path, "ab") as f:
+        f.write(struct.pack(">I", 9999) + b"partial")
+    records2, truncated2 = serve_journal.scan(path)
+    assert truncated2
+    assert [r["seq"] for r in records2] == [1, 2, 3]
+
+    # a second incarnation appends to the SAME file
+    j2 = serve_journal.JobJournal(path, prior_records=len(records2))
+    j2.append("done", job=1, job_key="k1", result={"ok": True})
+    assert j2.stats()["depth"] == 5
+    j2.close()
+
+
+def test_journal_path_and_enabled(tmp_path, monkeypatch):
+    monkeypatch.delenv("RACON_TPU_JOURNAL_DIR", raising=False)
+    assert serve_journal.journal_path("/tmp/x/s.sock") == \
+        "/tmp/x/s.sock.journal"
+    monkeypatch.setenv("RACON_TPU_JOURNAL_DIR", str(tmp_path))
+    assert serve_journal.journal_path("/tmp/x/s.sock") == \
+        str(tmp_path / "s.sock.journal")
+    monkeypatch.setenv("RACON_TPU_JOURNAL", "0")
+    assert not serve_journal.enabled()
+    monkeypatch.delenv("RACON_TPU_JOURNAL")
+    assert serve_journal.enabled()
+
+
+def test_replay_folds_records_across_incarnations():
+    spec = {"sequences": "a", "overlaps": "b", "targets": "c"}
+    records = [
+        {"kind": "journal_open", "pid": 10, "seq": 1},
+        # job A: admitted, checkpointed twice, interrupted in pid 10,
+        # requeued + checkpointed again in pid 11, interrupted again
+        {"kind": "admit", "pid": 10, "job": 1, "job_key": "A",
+         "spec": spec, "priority": 2, "tenant": "t",
+         "trace_id": "tr-A", "calib": {"epoch": "e1", "data": {}}},
+        {"kind": "start", "pid": 10, "job": 1, "job_key": "A"},
+        {"kind": "checkpoint", "pid": 10, "job": 1, "job_key": "A",
+         "windows": {"0": ["YQ==", True], "1": [None, False]}},
+        # job B: ran to completion in pid 10 (auto-keyed)
+        {"kind": "admit", "pid": 10, "job": 2, "spec": spec},
+        {"kind": "done", "pid": 10, "job": 2,
+         "result": {"ok": True, "job_id": 2, "fasta_b64": "Zg=="}},
+        # incarnation 2: A requeued (same key, new pid/job id), a
+        # later checkpoint supersedes window 1 and adds window 2
+        {"kind": "journal_open", "pid": 11, "seq": 1},
+        {"kind": "admit", "pid": 11, "job": 1, "job_key": "A",
+         "spec": spec, "priority": 2, "tenant": "t",
+         "trace_id": "tr-A", "calib": {"epoch": "e1", "data": {}},
+         "recovered_from": "10:1"},
+        {"kind": "checkpoint", "pid": 11, "job": 1, "job_key": "A",
+         "windows": {"1": ["Yg==", True], "2": ["Yw==", True]}},
+        # job C: journaled a terminal error
+        {"kind": "admit", "pid": 11, "job": 2, "job_key": "C",
+         "spec": spec},
+        {"kind": "error", "pid": 11, "job": 2, "job_key": "C",
+         "error": {"code": "job_failed", "reason": "boom"}},
+    ]
+    plan = recover.replay(records)
+    # terminal outcomes (success AND error) answer duplicates
+    assert plan["completed"]["auto-10-2"]["fasta_b64"] == "Zg=="
+    assert plan["completed"]["C"]["error"]["reason"] == "boom"
+    # one interrupted job with the cross-incarnation checkpoint union
+    assert [i["job_key"] for i in plan["interrupted"]] == ["A"]
+    a = plan["interrupted"][0]
+    assert a["windows"] == {"0": ["YQ==", True],
+                            "1": ["Yg==", True],
+                            "2": ["Yw==", True]}
+    assert a["priority"] == 2 and a["trace_id"] == "tr-A"
+    assert a["calib"]["epoch"] == "e1"
+    assert a["pid"] == 11   # latest admit wins
+    assert plan["stats"] == {"records": len(records), "jobs": 3,
+                             "completed": 1, "failed": 1,
+                             "interrupted": 1,
+                             "checkpoint_windows": 3}
+
+
+def test_faultinject_spec_parsing(monkeypatch):
+    from racon_tpu.obs import faultinject
+
+    monkeypatch.setenv("RACON_TPU_FAULT", "pre-demux:3")
+    assert faultinject.spec() == ("pre-demux", 3)
+    monkeypatch.setenv("RACON_TPU_FAULT", "post-admit")
+    assert faultinject.spec() == ("post-admit", 1)
+    for bad in ("", "nope:1", "pre-demux:x", "pre-demux:0", ":::"):
+        monkeypatch.setenv("RACON_TPU_FAULT", bad)
+        assert faultinject.spec() is None, bad
+    monkeypatch.delenv("RACON_TPU_FAULT")
+    assert faultinject.spec() is None
+    # unarmed hits are free no-ops
+    faultinject._reset_for_tests()
+    faultinject.hit("pre-demux")
+
+
+def test_calibration_epoch_pin(tmp_path, monkeypatch):
+    """epoch_snapshot + get_rates(pin=): a pinned snapshot beats the
+    persisted store, env rates beat the pin (CI golden pins stay
+    exact)."""
+    from racon_tpu.utils import calibrate
+
+    monkeypatch.setenv("RACON_TPU_CACHE_DIR", str(tmp_path))
+    for var in ("RACON_TPU_RATE_POA_DEV", "RACON_TPU_RATE_POA_CPU"):
+        monkeypatch.delenv(var, raising=False)
+    snap = calibrate.epoch_snapshot()
+    assert snap == {"epoch": "none", "data": {}}
+    pin = {calibrate._machine_key(8): {
+        "poa": {"dev": 42.0, "cpu": 7.0}}}
+    dev, cpu, src = calibrate.get_rates("poa", 8, 1.0, 2.0, pin=pin)
+    assert (dev, cpu, src) == (42.0, 7.0, "pinned")
+    # env wins over the pin
+    monkeypatch.setenv("RACON_TPU_RATE_POA_DEV", "5")
+    monkeypatch.setenv("RACON_TPU_RATE_POA_CPU", "6")
+    dev, cpu, src = calibrate.get_rates("poa", 8, 1.0, 2.0, pin=pin)
+    assert (dev, cpu, src) == (5.0, 6.0, "env")
+
+
+# ---------------------------------------------------------------------------
+# stale-socket takeover (the health-frame probe satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_stale_socket_takeover_and_live_refusal(serve_tmp):
+    proc_a, sock_path, _ = _start_server(serve_tmp, "own")
+    try:
+        # a second daemon on the LIVE socket must refuse, and the
+        # live daemon must keep answering
+        proc_b, _, log_b = _start_server(serve_tmp, "own",
+                                         expect_fail=True)
+        assert proc_b.wait(timeout=120) == 1
+        blog = open(log_b).read()
+        assert "live server" in blog and "refusing" in blog
+        assert client.health(sock_path)["ok"]
+
+        # SIGKILL the owner: socket + journal stay behind; a new
+        # daemon proves the peer dead and takes over
+        proc_a.kill()
+        proc_a.wait(timeout=60)
+        assert os.path.exists(sock_path)
+        proc_c, _, log_c = _start_server(serve_tmp, "own")
+        try:
+            assert client.health(sock_path)["ok"]
+            assert "taking over" in open(log_c).read()
+        finally:
+            _stop(proc_c, sock_path)
+    finally:
+        if proc_a.poll() is None:
+            proc_a.kill()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL at every fault site -> restart -> byte-identical (tentpole)
+# ---------------------------------------------------------------------------
+
+#: (site, nth): nth picks an arrival that exercises the site mid-job
+#: — journal-write's first arrival is the daemon's own journal_open
+#: record, so nth=2 lands on the job's admit record instead
+_KILL_SITES = [("post-admit", 1), ("mid-megabatch", 1),
+               ("pre-demux", 1), ("pre-done-record", 1),
+               ("journal-write", 2)]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site,nth", _KILL_SITES,
+                         ids=[s for s, _ in _KILL_SITES])
+def test_sigkill_recovery_byte_identical(serve_tmp, dataset, golden,
+                                         site, nth):
+    name = "kill-" + site
+    proc, sock_path, _ = _start_server(
+        serve_tmp, name,
+        extra_env={"RACON_TPU_FAULT": f"{site}:{nth}"})
+    journal_file = sock_path + ".journal"
+    key = f"chaos-{site}"
+    held = {}
+
+    def doomed_submit():
+        try:
+            held["resp"] = client.submit(sock_path, _spec(dataset),
+                                         job_key=key)
+        except client.ServeError as exc:
+            held["err"] = exc
+
+    t = threading.Thread(target=doomed_submit)
+    t.start()
+    # the armed site SIGKILLs the daemon mid-job
+    assert proc.wait(timeout=300) == -signal.SIGKILL
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert "err" in held, (
+        f"client got a response from a daemon killed at {site}: "
+        f"{held.get('resp')}")
+    assert os.path.exists(journal_file), "no journal left behind"
+
+    # restart on the same socket + journal, fault disarmed: the
+    # interrupted job (if its admit record survived) requeues and
+    # resumes from its checkpoints
+    proc2, _, log2 = _start_server(serve_tmp, name)
+    try:
+        # the duplicate keyed submit dedups onto the recovered run
+        # (or runs fresh when the kill beat the admit record —
+        # journal-write:2 — which is still exactly-once: the first
+        # attempt never admitted)
+        resp = client.submit_with_retry(sock_path, _spec(dataset),
+                                        retries=4, job_key=key)
+        assert resp["ok"], resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden, (
+            f"recovery after SIGKILL at {site} diverged from the "
+            "one-shot CLI bytes")
+        doc = client.health(sock_path)
+        assert doc["journal"]["enabled"]
+        assert doc["journal"]["path"] == journal_file
+        assert doc["journal"]["depth"] >= 2
+        if site != "journal-write":
+            assert doc["recovered_jobs"] == 1, doc
+            assert "journal replay" in open(log2).read()
+        # the journal now holds a terminal record for the key: a
+        # THIRD submit answers from the record even while this
+        # daemon is up
+        resp2 = client.submit(sock_path, _spec(dataset), job_key=key)
+        assert resp2["ok"]
+        assert resp2["fasta_b64"] == resp["fasta_b64"]
+        assert resp2["job_id"] == resp["job_id"]
+    finally:
+        _stop(proc2, sock_path)
+
+    # the record survives the daemon: a THIRD incarnation answers
+    # the duplicate from the journal without re-running
+    if site == "pre-done-record":
+        proc3, _, _ = _start_server(serve_tmp, name)
+        try:
+            resp3 = client.submit(sock_path, _spec(dataset),
+                                  job_key=key)
+            assert resp3["ok"]
+            assert base64.b64decode(resp3["fasta_b64"]) == golden
+            assert client.health(sock_path)["recovered_jobs"] == 0
+        finally:
+            _stop(proc3, sock_path)
+
+
+# ---------------------------------------------------------------------------
+# idempotent keys on a healthy daemon + the journal-off contract
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_duplicate_job_key_runs_once(serve_tmp, dataset, golden):
+    proc, sock_path, _ = _start_server(serve_tmp, "dedup")
+    try:
+        results = [None, None]
+
+        def run(slot):
+            results[slot] = client.submit(sock_path, _spec(dataset),
+                                          job_key="dup-1")
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for resp in results:
+            assert resp["ok"], resp
+            assert base64.b64decode(resp["fasta_b64"]) == golden
+        # both rendezvous'd on ONE job
+        assert results[0]["job_id"] == results[1]["job_id"]
+        # a post-completion duplicate answers from the record
+        resp = client.submit(sock_path, _spec(dataset),
+                             job_key="dup-1")
+        assert resp["ok"]
+        assert resp["job_id"] == results[0]["job_id"]
+        doc = client.status(sock_path)
+        assert doc["registry"]["counters"]["serve_dedup_hits"] >= 2
+        assert doc["journal"]["enabled"]
+        assert doc["recovered"] == {"requeued": 0, "failed": 0,
+                                    "completed": 0}
+        # malformed key -> structured bad_request
+        bad = client.request(sock_path,
+                             {"op": "submit", "job": _spec(dataset),
+                              "job_key": "bad key!"})
+        assert not bad["ok"]
+        assert bad["error"]["code"] == "bad_request"
+    finally:
+        _stop(proc, sock_path)
+
+
+@pytest.mark.slow
+def test_journal_off_byte_identical(serve_tmp, dataset, golden):
+    """RACON_TPU_JOURNAL=0: no journal file, no recovery machinery,
+    bytes identical to today's daemon."""
+    proc, sock_path, _ = _start_server(
+        serve_tmp, "nojournal", extra_env={"RACON_TPU_JOURNAL": "0"})
+    try:
+        resp = client.submit(sock_path, _spec(dataset),
+                             job_key="off-1")
+        assert resp["ok"], resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden
+        assert not os.path.exists(sock_path + ".journal")
+        doc = client.health(sock_path)
+        assert doc["journal"] == {"enabled": False}
+        # live dedup still works without a journal
+        resp2 = client.submit(sock_path, _spec(dataset),
+                              job_key="off-1")
+        assert resp2["ok"]
+        assert resp2["job_id"] == resp["job_id"]
+    finally:
+        _stop(proc, sock_path)
+
+
+@pytest.mark.slow
+def test_submit_with_retry_survives_connection_refused(serve_tmp,
+                                                       dataset,
+                                                       golden):
+    """The client-retry satellite: the daemon comes up AFTER the
+    first attempt; jittered backoff rides it out."""
+    sock_path = os.path.join(serve_tmp, "late.sock")
+    started = {}
+
+    def late_start():
+        time.sleep(2.0)
+        started["proc"], started["sock"], _ = _start_server(
+            serve_tmp, "late")
+
+    t = threading.Thread(target=late_start)
+    t.start()
+    try:
+        resp = client.submit_with_retry(
+            sock_path, _spec(dataset), retries=10, job_key="late-1")
+        assert resp["ok"], resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden
+    finally:
+        t.join(timeout=180)
+        if "proc" in started:
+            _stop(started["proc"], sock_path)
+    with pytest.raises(client.ServeError):
+        client.submit_with_retry(os.path.join(serve_tmp, "no.sock"),
+                                 _spec(dataset), retries=1)
