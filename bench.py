@@ -44,10 +44,23 @@ def read_fasta_gz(path):
     return {k: b"".join(v).upper() for k, v in seqs.items()}
 
 
+def _cold_result_cache():
+    """Empty the r18 result cache (racon_tpu/cache/) before a timed
+    leg: the cache memoizes identical units across runs in ONE
+    process, which is exactly what bench's repeat-timing structure
+    does artificially — without the reset every warm re-run would
+    measure lookups, not compute.  The keying overhead stays in the
+    timed path (that IS the cold-traffic cost); the hit path is
+    measured explicitly by serve_cache_bench()."""
+    from racon_tpu import cache as rcache
+    rcache._reset_for_tests()
+
+
 def run_polish(tpu_poa_batches=0, tpu_aligner_batches=0, threads=8,
                banded=False, window_length=500):
     from racon_tpu.core.polisher import PolisherType, create_polisher
 
+    _cold_result_cache()
     polisher = create_polisher(
         os.path.join(DATA, "sample_reads.fastq.gz"),
         os.path.join(DATA, "sample_overlaps.paf.gz"),
@@ -264,6 +277,7 @@ def _simulated_fallback():
                      "rb").read().split(b"\n")[1]
 
         def run(poa, al):
+            _cold_result_cache()
             pol = create_polisher(
                 reads, paf, draft, PolisherType.kC, 500, 10.0, 0.3,
                 True, 5, -4, -8, num_threads=8, tpu_poa_batches=poa,
@@ -308,7 +322,15 @@ def _simulated_fallback():
             f"(dist {d_cpu}), TPU {accel_wall:.1f}s warm / "
             f"{cold_wall:.1f}s cold (dist {d_tpu}), "
             f"deterministic {deterministic}")
-        print(json.dumps(record))
+    # the serve_cache leg is dataset-independent (it simulates its
+    # own inputs) and the r18 acceptance gates on its metrics, so it
+    # runs on fallback hosts too
+    try:
+        record.update(serve_cache_bench())
+    except Exception as exc:
+        log(f"[bench] serve_cache bench skipped "
+            f"({type(exc).__name__}: {exc})")
+    print(json.dumps(record))
 
 
 def main():
@@ -558,6 +580,12 @@ def main():
             log(f"[bench] serve_saturation bench skipped "
                 f"({type(exc).__name__}: {exc})")
 
+        try:
+            extra.update(serve_cache_bench())
+        except Exception as exc:
+            log(f"[bench] serve_cache bench skipped "
+                f"({type(exc).__name__}: {exc})")
+
     record = {
         "metric": "sample_e2e_polish_wall_s",
         "value": round(accel_wall, 3),
@@ -619,6 +647,7 @@ def scale_bench():
                      "rb").read().split(b"\n")[1]
 
         def run(poa, al):
+            _cold_result_cache()
             pol = create_polisher(
                 reads, paf, draft, PolisherType.kC, 500, 10.0, 0.3,
                 True, 5, -4, -8, num_threads=8, tpu_poa_batches=poa,
@@ -700,6 +729,7 @@ def _mega_leg(prefix, label, sim_kwargs, tpu_need_s, cpu_need_s,
                      "rb").read().split(b"\n")[1]
 
         def run(poa, al):
+            _cold_result_cache()
             pol = create_polisher(
                 reads, paf, draft, PolisherType.kC, 500, 10.0, 0.3,
                 True, 5, -4, -8, num_threads=8, tpu_poa_batches=poa,
@@ -880,6 +910,11 @@ def serve_saturation_bench():
 
     def one_round(fuse, reads, paf, draft):
         os.environ["RACON_TPU_FUSE"] = "1" if fuse else "0"
+        # both rounds start result-cache-cold so fused-vs-unfused
+        # compares batching, not cache temperature (jobs within a
+        # round still share fills — that cross-job reuse is real
+        # serving behavior and hits both rounds identically)
+        _cold_result_cache()
         devutil.DEVICE_UTIL.reset()
         base_disp = REGISTRY.value("fusion_dispatches")
         base_mega = REGISTRY.value("fused_megabatches")
@@ -958,6 +993,100 @@ def serve_saturation_bench():
         f"poa util {plain['poa_util']:.0%}, "
         f"{plain['poa_dispatches']} dispatches); bytes equal: "
         f"{out['serve_sat_bytes_equal']}")
+    return out
+
+
+def serve_cache_bench():
+    """Cold-vs-warm result-cache leg (r18): the SAME job submitted
+    twice through an in-process JobScheduler (daemon scheduler +
+    session runner, no socket) with the content-addressed result
+    cache (racon_tpu/cache/) on.  The first run fills the cache; the
+    second run's POA/align units hit it and demux without occupying
+    device megabatch slots, so warm device dispatches drop strictly
+    below cold and warm jobs/s rises — while the output bytes stay
+    identical (a hit IS the recomputation, byte for byte).  Default
+    ON everywhere (one small job twice);
+    RACON_TPU_BENCH_SERVE_CACHE=0 disables."""
+    if os.environ.get("RACON_TPU_BENCH_SERVE_CACHE", "1") != "1":
+        return {}
+    if not _budget_left(140 * _host_factor(), "serve_cache leg"):
+        return {}
+    import tempfile
+
+    from racon_tpu import cache as rcache
+    from racon_tpu.obs import REGISTRY, devutil
+    from racon_tpu.serve.scheduler import JobScheduler
+    from racon_tpu.serve.session import run_job
+    from racon_tpu.tools import simulate
+
+    def one_round(label, reads, paf, draft):
+        devutil.DEVICE_UTIL.reset()
+        base_hit = REGISTRY.value("cache_hit")
+        base_miss = REGISTRY.value("cache_miss")
+        sched = JobScheduler(run_job, max_queue=1, max_jobs=1)
+        t0 = time.monotonic()
+        job = sched.submit({
+            "sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 2, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1, "tenant": "cachebench"})
+        job.done.wait()
+        wall = time.monotonic() - t0
+        sched.drain(timeout=60)
+        if not (job.result or {}).get("ok"):
+            raise RuntimeError(
+                f"serve_cache {label} job failed: {job.result}")
+        du = devutil.DEVICE_UTIL.snapshot()
+        hits = REGISTRY.value("cache_hit") - base_hit
+        misses = REGISTRY.value("cache_miss") - base_miss
+        total = hits + misses
+        return {
+            "wall_s": round(wall, 3),
+            "dispatches": sum(int(e.get("n_dispatches", 0))
+                              for e in du.values()),
+            "hits": int(hits),
+            "hit_ratio": round(hits / total, 4) if total else 0.0,
+            "fasta": job.result["fasta_b64"],
+        }
+
+    prior = {k: os.environ.get(k)
+             for k in ("RACON_TPU_CACHE", "RACON_TPU_CACHE_PERSIST")}
+    os.environ["RACON_TPU_CACHE"] = "1"
+    os.environ.pop("RACON_TPU_CACHE_PERSIST", None)
+    # drop anything earlier legs filled: the cold round must be cold
+    rcache._reset_for_tests()
+    try:
+        with tempfile.TemporaryDirectory(
+                prefix="racon_sercache_") as tmp:
+            reads, paf, draft = simulate.simulate(
+                tmp, genome_len=60_000, coverage=8, read_len=3000,
+                seed=23)
+            cold = one_round("cold", reads, paf, draft)
+            warm = one_round("warm", reads, paf, draft)
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        rcache._reset_for_tests()
+    out = {
+        "serve_cache_cold_wall_s": cold["wall_s"],
+        "serve_cache_warm_wall_s": warm["wall_s"],
+        "serve_cache_warm_jobs_per_s": round(
+            1.0 / max(warm["wall_s"], 1e-9), 4),
+        "serve_cache_cold_dispatches": cold["dispatches"],
+        "serve_cache_warm_dispatches": warm["dispatches"],
+        "serve_cache_hit_ratio": warm["hit_ratio"],
+        "serve_cache_hits": warm["hits"],
+        # the cache must never change a job's bytes: same job, cold
+        # vs warm, must produce the same FASTA
+        "serve_cache_bytes_equal": cold["fasta"] == warm["fasta"],
+    }
+    log(f"[bench] serve_cache: cold {cold['wall_s']:.1f}s "
+        f"({cold['dispatches']} dispatches) vs warm "
+        f"{warm['wall_s']:.1f}s ({warm['dispatches']} dispatches, "
+        f"hit ratio {warm['hit_ratio']:.0%}, {warm['hits']} hits); "
+        f"bytes equal: {out['serve_cache_bytes_equal']}")
     return out
 
 

@@ -79,12 +79,14 @@ SHARE_METRICS = (
     "mega_ont_device_window_share",
     "serve_sat_poa_util",
     "serve_sat_fusion_occupancy",
+    "serve_cache_hit_ratio",
 )
 
 #: throughput metrics, higher is better (relative threshold, shares
 #: the wall tolerance -- both measure the same host jitter)
 RATE_METRICS = (
     "serve_sat_jobs_per_s",
+    "serve_cache_warm_jobs_per_s",
 )
 
 #: absolute slack for edit-distance drift on top of the relative tol

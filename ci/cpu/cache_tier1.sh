@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Result-cache lane (ISSUE 14 CI satellite): the content-addressed
+# cache must be a pure lookup optimization — same bytes with it on,
+# off, tiny, or mid-eviction.
+#
+#   1. the FULL tier-1 suite with the cache pinned ON and a
+#      DELIBERATELY TINY byte budget (8 MB) so the eviction path
+#      runs constantly under every byte-identity golden, not just
+#      in the targeted LRU unit test — byte identity must survive
+#      entries being evicted mid-run.  PYTHONDEVMODE=1 surfaces
+#      unclosed segment fds across the simulated restarts; the
+#      faulthandler timeout dumps every thread's stack if a fill
+#      race ever deadlocks under the store lock.
+#   2. a two-run warm-hit smoke: the same polish twice in one
+#      process; the second run must record cache hits (the
+#      cross-round/cross-job win the tier exists for) and emit
+#      byte-identical FASTA.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export PYTHONDEVMODE=1
+export RACON_TPU_CACHE=1
+export RACON_TPU_CACHE_MB=8
+unset RACON_TPU_CACHE_PERSIST || true
+python -m pytest tests/ -q -m "not slow" \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"
+
+echo "[cache_tier1] two-run warm-hit smoke"
+python - <<'EOF'
+import tempfile
+
+from racon_tpu.obs import REGISTRY
+from racon_tpu.tools import simulate
+from racon_tpu.core.polisher import PolisherType, create_polisher
+
+
+def polish(reads, paf, draft):
+    pol = create_polisher(
+        reads, paf, draft, PolisherType.kC, 500, 10.0, 0.3, True,
+        5, -4, -8, num_threads=4, tpu_poa_batches=1,
+        tpu_aligner_batches=1)
+    pol.initialize()
+    return b"".join(s.data for s in pol.polish(True))
+
+
+with tempfile.TemporaryDirectory(prefix="racon_cachesmoke_") as tmp:
+    reads, paf, draft = simulate.simulate(
+        tmp, genome_len=12_000, coverage=6, read_len=900, seed=5)
+    first = polish(reads, paf, draft)
+    h0 = REGISTRY.value("cache_hit")
+    second = polish(reads, paf, draft)
+    hits = REGISTRY.value("cache_hit") - h0
+    assert second == first, "warm run bytes differ from cold run"
+    assert hits > 0, "warm run recorded no cache hits"
+    print(f"[cache_tier1] warm-hit smoke ok: {hits} hits, "
+          f"bytes identical")
+EOF

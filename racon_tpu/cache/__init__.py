@@ -1,0 +1,117 @@
+"""Content-addressed unit-result cache (r18).
+
+Byte-determinism — pinned since PR 3 and re-proven at every crash
+site in PR 13 — makes memoization semantically free: an identical
+(canonical input bytes, engine key, code epoch) work unit MUST
+produce identical output bytes, so serving a cached result is
+indistinguishable from recomputing it.  This package turns repeat
+polish traffic (overlapping references across jobs, ``--split``
+parts sharing contigs, ``--rounds N`` windows that already
+converged) from a load problem into a lookup problem:
+
+* :mod:`racon_tpu.cache.keying` — canonical digests per unit kind
+  (POA window, WFA pair, banded pair, CPU scan pair) + the
+  engine-code epoch that makes a knob change invalidate every key.
+* :mod:`racon_tpu.cache.store`  — the byte-budgeted in-process LRU
+  and the optional shared persistent segment tier.
+* :mod:`racon_tpu.cache.codec`  — exact-size tagged value blobs.
+
+Consulted at unit submit in the device executor
+(racon_tpu/tpu/executor.py — hits demux immediately without
+occupying megabatch slots), in the CPU scan ladder and in the staged
+``core/polisher.py`` path, so the win exists on every backend.
+
+Knobs (provenance.KNOWN_KNOBS):
+
+* ``RACON_TPU_CACHE``          — "0" disables (default on)
+* ``RACON_TPU_CACHE_MB``       — LRU byte budget in MB (default 256)
+* ``RACON_TPU_CACHE_PERSIST``  — persistent tier: unset/"0" = off,
+  "1" = ``<cache_root>/results`` under the RACON_TPU_CACHE_DIR root
+  the XLA/AOT caches already share, any other value = that directory
+* ``RACON_TPU_CACHE_DIR``      — the shared cache ROOT (pre-existing
+  knob; also holds xla/, aot/, calibration.json)
+
+Policy/observability never leak into bytes: a hit batch is excluded
+from calibration measurement (the collect closures carry a
+``cache_hits`` attribute the polishers gate recording on), and
+cache-on/off/persistent outputs are pinned byte-identical in
+tests/test_cache.py.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from racon_tpu.cache import keying  # noqa: F401  (re-export)
+from racon_tpu.cache.store import MISS, ResultCache  # noqa: F401
+
+_DEF_MB = 256.0
+_MIN_BUDGET = 4096
+
+_lock = threading.Lock()
+_cache = None
+_cfg = None
+
+
+def enabled() -> bool:
+    return os.environ.get("RACON_TPU_CACHE", "1") != "0"
+
+
+def budget_bytes() -> int:
+    try:
+        mb = float(os.environ.get("RACON_TPU_CACHE_MB", "")
+                   or _DEF_MB)
+    except ValueError:
+        mb = _DEF_MB
+    return max(_MIN_BUDGET, int(mb * (1 << 20)))
+
+
+def persist_dir():
+    """Directory of the shared persistent tier, or None (off)."""
+    v = os.environ.get("RACON_TPU_CACHE_PERSIST", "")
+    if not v or v == "0":
+        return None
+    if v == "1":
+        from racon_tpu.utils.xla_cache import cache_root
+
+        root = cache_root()
+        return os.path.join(root, "results") if root else None
+    return v
+
+
+def result_cache() -> ResultCache:
+    """The process-wide cache, rebuilt when its config knobs change
+    (tests flip budgets/persistence via the environment)."""
+    global _cache, _cfg
+    cfg = (budget_bytes(), persist_dir())
+    with _lock:
+        if _cache is None or cfg != _cfg:
+            if _cache is not None:
+                _cache.close()
+            _cache = ResultCache(cfg[0], persist_dir=cfg[1])
+            _cfg = cfg
+        return _cache
+
+
+def stats() -> dict:
+    """The telemetry block served under ``cache`` in the daemon's
+    ``metrics`` / ``health`` / ``explain`` frames."""
+    if not enabled():
+        return {"enabled": False}
+    with _lock:
+        live = _cache
+    if live is None:
+        return {"enabled": True, "entries": 0, "bytes": 0,
+                "hits": 0, "misses": 0, "fills": 0, "evicts": 0,
+                "hit_ratio": 0.0, "budget_bytes": budget_bytes()}
+    return live.stats()
+
+
+def _reset_for_tests() -> None:
+    global _cache, _cfg
+    with _lock:
+        if _cache is not None:
+            _cache.close()
+        _cache = None
+        _cfg = None

@@ -504,10 +504,43 @@ class Polisher:
     # accelerator seam #2 + polish (reference: src/polisher.cpp:485-547)
     # ------------------------------------------------------------------
 
+    def _consensus_cached(self, window, epoch=None):
+        """One window's POA consensus through the content-addressed
+        result cache (racon_tpu/cache): hit -> adopt the cached
+        bytes, miss -> compute and fill.  Returns ``(polished_flag,
+        was_hit)``.  Windows below the 3-layer polish threshold
+        bypass the cache — the backbone copy is cheaper than a
+        lookup.  The "cpu" key space is disjoint from the device
+        engine's: the two pipelines resolve cost ties independently,
+        so their results must never alias."""
+        from racon_tpu import cache as rcache
+
+        if len(window.sequences) < 3 or not rcache.enabled():
+            return window.generate_consensus(
+                self.engine, self.trim), False
+        c = rcache.result_cache()
+        if epoch is None:
+            epoch = rcache.keying.engine_epoch()
+        key = rcache.keying.poa_key(
+            "cpu", (self.match, self.mismatch, self.gap), self.trim,
+            window, epoch)
+        v = c.get(key)
+        if v is not rcache.MISS:
+            cons, ok = v
+            window.consensus = cons
+            return bool(ok), True
+        ok = window.generate_consensus(self.engine, self.trim)
+        c.put(key, (window.consensus, ok))
+        return ok, False
+
     def generate_consensuses(self) -> List[bool]:
         """Generate consensus for every window; returns polished flags."""
+        from racon_tpu import cache as rcache
+
+        epoch = rcache.keying.engine_epoch() if rcache.enabled() \
+            else None
         return self._run_pooled(
-            [(w.generate_consensus, (self.engine, self.trim))
+            [(lambda w=w: self._consensus_cached(w, epoch)[0], ())
              for w in self.windows],
             "[racon_tpu::Polisher::polish] generating consensus",
             "[racon_tpu::Polisher::polish] generated consensus")

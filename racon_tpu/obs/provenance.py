@@ -105,6 +105,15 @@ KNOWN_KNOBS = {
     "RACON_TPU_JOURNAL_DIR": "",
     "RACON_TPU_JOURNAL_FSYNC": "1",
     "RACON_TPU_FAULT": "",
+    # result cache (r18, racon_tpu/cache/): content-addressed unit
+    # memoization off-switch, in-process LRU budget in MB, and the
+    # shared persistent tier ("1" = <cache_root()>/results, any other
+    # non-empty value = an explicit directory).  Policy-only knobs:
+    # they never change output bytes, so cache/keying.py EXCLUDES
+    # them from the engine epoch that keys every cached result.
+    "RACON_TPU_CACHE": "1",
+    "RACON_TPU_CACHE_MB": "256",
+    "RACON_TPU_CACHE_PERSIST": "",
 }
 
 # host-capability probe reference wall (bench.py's budget scaling):

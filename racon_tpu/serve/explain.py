@@ -187,6 +187,18 @@ def render_overview(doc: dict) -> str:
     c = render_counts(doc.get("counts"))
     if c:
         lines.append(c.rstrip("\n"))
+    # r18: the result-cache block rides the explain frame — hit ratio
+    # and bytes-resident explain why a warm daemon's measured walls
+    # undercut the (discounted) admission predictions
+    ca = doc.get("cache") or {}
+    if ca.get("enabled"):
+        total = ca.get("hits", 0) + ca.get("misses", 0)
+        lines.append(
+            f"result cache: hit {ca.get('hit_ratio', 0.0) * 100:.0f}% "
+            f"({ca.get('hits', 0)}/{total})  "
+            f"{ca.get('bytes', 0) / (1 << 20):.1f} MB resident  "
+            f"{ca.get('entries', 0)} entries  "
+            f"{ca.get('fills', 0)} fills  {ca.get('evicts', 0)} evicted")
     lines.append("")
     lines.append(render_drift(doc.get("calhealth")).rstrip("\n"))
     return "\n".join(lines) + "\n"

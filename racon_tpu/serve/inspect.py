@@ -70,6 +70,10 @@ def _detail(ev: dict) -> str:
                 f"items={ev.get('items', '?')} "
                 f"occupancy={ev.get('occupancy', '?')} "
                 f"tenants={','.join(ev.get('tenants', []))}")
+    if kind == "cache_hit":
+        return (f"{ev.get('unit_kind', '?')} "
+                f"hits={ev.get('hits', '?')}/{ev.get('items', '?')} "
+                f"misses={ev.get('misses', '?')}")
     if kind == "unit_retry":
         return (f"{ev.get('unit_kind', '?')} "
                 f"tenant={ev.get('tenant', 'default')} "

@@ -113,6 +113,28 @@ Durability (r17, racon_tpu/serve/journal.py + recover.py):
   length-prefixed JSON framing, one record per frame — see
   racon_tpu/serve/journal.py for the record schema
   (``racon-tpu-journal-v1``) and ``RACON_TPU_JOURNAL*`` knobs.
+
+Result cache (r18, racon_tpu/cache/):
+
+* ``metrics`` / ``watch`` / ``explain`` frames carry a ``cache``
+  block — the content-addressed result cache's stats (``enabled``,
+  ``entries``, ``bytes``, ``budget_bytes``, ``hits``, ``misses``,
+  ``fills``, ``evicts``, ``disk_hits``, ``hit_ratio``, and, when the
+  persistent tier is on, ``persist`` with its directory and indexed
+  entry count).  ``health`` carries a cheaper ``cache`` summary
+  (``enabled``/``hit_ratio``/``bytes``/``entries``).  The
+  ``cache_hit``/``cache_miss``/``cache_fill``/``cache_evict``
+  counters also ride the registry snapshot, so fleet merges
+  (racon_tpu/obs/aggregate.py) sum them exactly and the merged
+  hit ratio is the true fleet ratio.  Cache state is policy-only:
+  a hit returns the same bytes the engines would recompute
+  (pinned by tests/test_cache.py), so no protocol field changes
+  meaning based on cache temperature.
+* The persistent segment files (``seg-<pid>.rseg`` under the cache
+  root) reuse this module's u32BE length-prefix framing with a
+  binary body (32-byte key + crc32 + codec blob) — see
+  racon_tpu/cache/store.py (``racon-tpu-rcache-v1``) and the
+  ``RACON_TPU_CACHE*`` knobs.
 """
 
 from __future__ import annotations

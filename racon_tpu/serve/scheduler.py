@@ -80,6 +80,20 @@ def _mean_fusion_occupancy() -> float:
     return h["sum"] / h["count"]
 
 
+def _observed_hit_ratio() -> float:
+    """Process-wide result-cache hit ratio (r18): hits over lookups
+    since daemon start, 0.0 with no traffic or with the cache off.
+    Trailing and cross-job by construction — exactly the crudeness
+    the admission price already accepts for rates and occupancy."""
+    from racon_tpu import cache as rcache
+
+    if not rcache.enabled():
+        return 0.0
+    hits = REGISTRY.value("cache_hit")
+    total = hits + REGISTRY.value("cache_miss")
+    return hits / total if total else 0.0
+
+
 def estimate_job(spec: dict, concurrency: int = 1) -> dict:
     """Price a submission from input stats alone.
 
@@ -105,7 +119,8 @@ def estimate_job(spec: dict, concurrency: int = 1) -> dict:
     est = calibrate.predict_walls(align_s, poa_s,
                                   overlap_s=min(align_s, poa_s),
                                   concurrency=concurrency,
-                                  occupancy=_mean_fusion_occupancy())
+                                  occupancy=_mean_fusion_occupancy(),
+                                  hit_ratio=_observed_hit_ratio())
     est["input_bytes"] = sizes
     return est
 

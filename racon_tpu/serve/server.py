@@ -196,6 +196,8 @@ class PolishServer:
         # device_util.* gauges the JSON section reports
         from racon_tpu.tpu import executor as device_executor
 
+        from racon_tpu import cache as rcache
+
         du = devutil.DEVICE_UTIL.publish(REGISTRY)
         REGISTRY.set("serve_uptime_s",
                      round(obs_trace.now() - self._t_start, 3))
@@ -208,6 +210,7 @@ class PolishServer:
             "queue": self.scheduler.snapshot(),
             "device_util": du,
             "fusion": device_executor.get_executor().stats(),
+            "cache": rcache.stats(),
             "slo": export.slo_summary(snap),
             "calhealth": export.drift_summary(snap),
             "snapshot": export.json_snapshot(snap),
@@ -254,12 +257,15 @@ class PolishServer:
         except (TypeError, ValueError):
             return protocol.error_frame(
                 "bad_request", "explain: job/last must be integers")
+        from racon_tpu import cache as rcache
+
         snap = REGISTRY.snapshot()
         return {
             "ok": True,
             "pid": os.getpid(),
             "identity": self._identity(),
             "calhealth": export.drift_summary(snap),
+            "cache": rcache.stats(),
             "ring": obs_decision.DECISIONS.stats(),
             "counts": obs_decision.DECISIONS.counts(job=job),
             "events": obs_decision.DECISIONS.snapshot(job=job,
@@ -289,10 +295,22 @@ class PolishServer:
             "flight_ring_depth": obs_flight.FLIGHT.stats()["size"],
             "fusion_queue_depth":
                 device_executor.get_executor().pending_units(),
+            "cache": self._cache_health(),
             "journal": self._journal_doc(),
             "recovered_jobs": self.recovered["requeued"],
             "recovery": dict(self.recovered),
         }
+
+    def _cache_health(self) -> dict:
+        """The result cache's cheap health block (r18): hit ratio +
+        resident bytes, without the full stats walk."""
+        from racon_tpu import cache as rcache
+
+        st = rcache.stats()
+        return {"enabled": st.get("enabled", False),
+                "hit_ratio": st.get("hit_ratio", 0.0),
+                "bytes": st.get("bytes", 0),
+                "entries": st.get("entries", 0)}
 
     def _journal_doc(self) -> dict:
         """The write-ahead journal's health block (r17)."""

@@ -114,6 +114,19 @@ def render(doc: dict) -> str:
             lines.append(f"{stage:<12s}    -  {'-':<8s}  {'-':<8s}  "
                          f"{'-':<10s}  {_drift(stage)}")
 
+    # r18: result-cache line — hit ratio + resident bytes, so a warm
+    # daemon's lookup-instead-of-dispatch win is visible at a glance
+    ca = doc.get("cache") or {}
+    if ca.get("enabled"):
+        total = ca.get("hits", 0) + ca.get("misses", 0)
+        lines.append("")
+        lines.append(
+            f"cache  hit {ca.get('hit_ratio', 0.0) * 100:.0f}% "
+            f"({ca.get('hits', 0)}/{total})  "
+            f"{ca.get('bytes', 0) / (1 << 20):.1f} MB resident  "
+            f"{ca.get('entries', 0)} entries  "
+            f"{ca.get('evicts', 0)} evicted")
+
     slo = doc.get("slo") or {}
     if slo:
         lines.append("")
@@ -177,6 +190,25 @@ def render_fleet(doc: dict) -> str:
                 f"{name:<22s} {s['count']:>5d}   "
                 f"{_fmt_s(s['p50']):<8s}  {_fmt_s(s['p90']):<8s}  "
                 f"{_fmt_s(s['p99']):<8s}")
+
+    # r18: fleet-wide cache effectiveness — the hit/miss counters sum
+    # EXACTLY across daemons (racon_tpu/obs/aggregate.py), so the
+    # merged ratio is the true fleet ratio, not a mean of ratios;
+    # bytes-resident stays per-daemon (a gauge sum means little, but
+    # the per_source map keeps attribution)
+    merged = (doc.get("merged") or {})
+    mc = merged.get("counters") or {}
+    hits, misses = mc.get("cache_hit", 0), mc.get("cache_miss", 0)
+    if hits or misses:
+        ratio = hits / (hits + misses)
+        mb = ((merged.get("gauges") or {}).get("cache_bytes")
+              or {}).get("sum", 0) / (1 << 20)
+        lines.append("")
+        lines.append(
+            f"fleet cache  hit {ratio * 100:.0f}% "
+            f"({hits}/{hits + misses})  {mb:.1f} MB resident  "
+            f"{mc.get('cache_fill', 0)} fills  "
+            f"{mc.get('cache_evict', 0)} evicted")
 
     # r16: fleet-wide calibration health from the exactly-merged
     # snapshot union (racon_tpu/serve/fleet.py merge_fleet)

@@ -363,7 +363,11 @@ class DeviceExecutor:
                                            pcap, lcap, kcap, max_depth,
                                            banded, mesh)
                 self._engines[key] = engine
-        return PoaEngineHandle(self, engine, tenant, cap)
+        handle = PoaEngineHandle(self, engine, tenant, cap)
+        # the engine-identity tuple doubles as the result cache's
+        # device-space config key (racon_tpu/cache/keying.poa_key)
+        handle.cfg_key = key
+        return handle
 
     # -- submissions ---------------------------------------------------------
     def _tag_unit(self, unit: _Unit) -> None:
@@ -388,9 +392,84 @@ class DeviceExecutor:
             TRACER.add_flow(f"executor.unit.{unit.kind}",
                             unit.flow_id, "s", jobs=jobs)
 
+    # -- result cache (r18) --------------------------------------------------
+    def _cache_partition(self, kind, n, key_fn):
+        """Split ``n`` items into cache hits and misses BEFORE any
+        device dispatch.  Returns ``None`` when the cache is off,
+        else ``(cache, keys, hits, miss)`` where ``keys[i]`` is None
+        for uncacheable items (they ride the miss dispatch but are
+        never filled), ``hits`` maps item index -> decoded value and
+        ``miss`` lists indices to compute.  Hits never occupy
+        megabatch slots — an all-hit submission touches neither the
+        fusion queue nor the engine."""
+        from racon_tpu import cache as rcache
+
+        if n == 0 or not rcache.enabled():
+            return None
+        cache = rcache.result_cache()
+        epoch = rcache.keying.engine_epoch()
+        keys, hits, miss = [None] * n, {}, []
+        for i in range(n):
+            k = key_fn(i, epoch)
+            if k is None:
+                miss.append(i)
+                continue
+            keys[i] = k
+            v = cache.get(k)
+            if v is rcache.MISS:
+                miss.append(i)
+            else:
+                hits[i] = v
+        if hits:
+            obs_flight.FLIGHT.record(
+                "cache_hit", unit_kind=kind, hits=len(hits),
+                misses=len(miss), items=n)
+        return cache, keys, hits, miss
+
     def submit_poa(self, handle: PoaEngineHandle, windows, trim,
                    pool=None):
-        """Returns a zero-arg collect closure, like the engine's."""
+        """Returns a zero-arg collect closure, like the engine's.
+
+        Consults the content-addressed result cache first: cached
+        windows are served from memory, only the misses are
+        dispatched (fused or passthrough), and the collect closure
+        merges + fills.  ``collect.cache_hits`` tells the polisher
+        to exclude the batch from calibration measurement — a
+        partially-served batch's wall says nothing about device
+        rates (policy only; bytes are identical either way)."""
+        windows = list(windows)
+        from racon_tpu.cache import keying as _keying
+
+        cfg = getattr(handle, "cfg_key", None)
+        part = None if cfg is None else self._cache_partition(
+            "poa", len(windows),
+            lambda i, epoch: (
+                _keying.poa_key("dev", cfg, trim, windows[i], epoch)
+                if len(windows[i].sequences) >= 3 else None))
+        if part is None:
+            return self._submit_poa_raw(handle, windows, trim, pool)
+        cache, keys, hits, miss = part
+        inner = self._submit_poa_raw(
+            handle, [windows[i] for i in miss], trim, pool) \
+            if miss else None
+
+        def collect():
+            out = [None] * len(windows)
+            if inner is not None:
+                rows = inner()
+                for j, i in enumerate(miss):
+                    out[i] = rows[j]
+                    if keys[i] is not None:
+                        cache.put(keys[i], rows[j])
+            for i, v in hits.items():
+                out[i] = v
+            return out
+
+        collect.cache_hits = len(hits)
+        return collect
+
+    def _submit_poa_raw(self, handle: PoaEngineHandle, windows, trim,
+                        pool=None):
         engine = handle._eng
         if not self._fusion_active():
             return engine.consensus_batch_async(windows, trim,
@@ -415,6 +494,31 @@ class DeviceExecutor:
 
     def align_wfa(self, queries, targets, lq, emax, mesh=None,
                   tenant=None):
+        """Cache-aware WFA pair dispatch: cached pairs are served
+        from memory, only miss pairs hit the device; the collect
+        re-stacks rows in submission order (row widths are fixed per
+        (lq, emax) AOT key, and consumers only read ``tape[:nent]``,
+        so zero-padding to the widest row is byte-neutral)."""
+        queries, targets = list(queries), list(targets)
+        from racon_tpu.cache import keying as _keying
+
+        mk = _mesh_key(mesh)
+        part = self._cache_partition(
+            "wfa", len(queries),
+            lambda i, epoch: _keying.wfa_key(
+                queries[i], targets[i], lq, emax, mk, epoch))
+        if part is None:
+            return self._align_wfa_raw(queries, targets, lq, emax,
+                                       mesh, tenant)
+        cache, keys, hits, miss = part
+        inner = self._align_wfa_raw(
+            [queries[i] for i in miss], [targets[i] for i in miss],
+            lq, emax, mesh, tenant) if miss else None
+        return self._align_cached_collect(len(queries), inner, cache,
+                                          keys, hits, miss, n_arrays=3)
+
+    def _align_wfa_raw(self, queries, targets, lq, emax, mesh=None,
+                       tenant=None):
         from racon_tpu.tpu import align_pallas
 
         if not self._fusion_active():
@@ -436,6 +540,84 @@ class DeviceExecutor:
 
     def align_band(self, queries, targets, lq, lt, wb, mesh=None,
                    centers=None, tenant=None):
+        """Cache-aware banded pair dispatch (see :meth:`align_wfa`);
+        keys hash the per-pair pinned center path too — an empirical
+        center changes the band, so it must change the key."""
+        queries, targets = list(queries), list(targets)
+        cent = list(centers) if centers is not None \
+            else [None] * len(queries)
+        from racon_tpu.cache import keying as _keying
+
+        mk = _mesh_key(mesh)
+        part = self._cache_partition(
+            "band", len(queries),
+            lambda i, epoch: _keying.band_key(
+                queries[i], targets[i], lq, lt, wb, cent[i], mk,
+                epoch))
+        if part is None:
+            return self._align_band_raw(queries, targets, lq, lt, wb,
+                                        mesh, cent, tenant)
+        cache, keys, hits, miss = part
+        inner = self._align_band_raw(
+            [queries[i] for i in miss], [targets[i] for i in miss],
+            lq, lt, wb, mesh, [cent[i] for i in miss], tenant) \
+            if miss else None
+        return self._align_cached_collect(len(queries), inner, cache,
+                                          keys, hits, miss, n_arrays=3)
+
+    def _align_cached_collect(self, n, inner, cache, keys, hits,
+                              miss, n_arrays):
+        """Collect closure merging cached align rows with the miss
+        dispatch's stacked arrays (``(rows_2d, col_1d, col_1d)``
+        shape for both wfa and band).  Fills the cache from the
+        fresh rows; with zero hits the fresh arrays pass through
+        untouched."""
+        import numpy as np
+
+        def collect():
+            fresh = inner() if inner is not None else None
+            if fresh is not None:
+                rows2d = np.asarray(fresh[0])
+                cols = [np.asarray(a) for a in fresh[1:]]
+                for j, i in enumerate(miss):
+                    if keys[i] is not None:
+                        cache.put(keys[i], (rows2d[j],)
+                                  + tuple(int(c[j]) for c in cols))
+                if not hits:
+                    return fresh
+            rows, col_vals = [None] * n, \
+                [[0] * n for _ in range(n_arrays - 1)]
+            for i, v in hits.items():
+                rows[i] = np.asarray(v[0])
+                for a, cv in enumerate(v[1:]):
+                    col_vals[a][i] = cv
+            if fresh is not None:
+                for j, i in enumerate(miss):
+                    rows[i] = rows2d[j]
+                    for a, c in enumerate(cols):
+                        col_vals[a][i] = int(c[j])
+            width = max(r.shape[0] for r in rows)
+            dtype = rows[0].dtype
+            stacked = np.zeros((n, width), dtype=dtype)
+            for i, r in enumerate(rows):
+                stacked[i, :r.shape[0]] = r
+            out = (stacked,) + tuple(
+                np.asarray(cv, dtype=np.int64) for cv in col_vals)
+            return out
+
+        def device_s():
+            ds = getattr(inner, "device_s", None)
+            try:
+                return float(ds()) if callable(ds) else 0.0
+            except Exception:
+                return 0.0
+
+        collect.device_s = device_s
+        collect.cache_hits = len(hits)
+        return collect
+
+    def _align_band_raw(self, queries, targets, lq, lt, wb,
+                        mesh=None, centers=None, tenant=None):
         from racon_tpu.tpu import align_pallas
 
         if not self._fusion_active():

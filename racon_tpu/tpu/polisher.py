@@ -32,6 +32,7 @@ from racon_tpu.obs import MetricAttr
 from racon_tpu.obs import calhealth as obs_calhealth
 from racon_tpu.obs import devutil as obs_devutil
 from racon_tpu.obs import faultinject
+from racon_tpu.obs import flight as obs_flight
 from racon_tpu.obs import trace as obs_trace
 from racon_tpu.obs import decision as obs_decision
 
@@ -926,6 +927,10 @@ class TPUPolisher(Polisher):
             # by the incarnation that computed them)
             self._checkpoint_cb(adopted_ckpt)
 
+        from racon_tpu import cache as _rcache
+        _epoch = _rcache.keying.engine_epoch() if _rcache.enabled() \
+            else None
+
         def cpu_worker():
             while True:
                 with lock:
@@ -933,8 +938,12 @@ class TPUPolisher(Polisher):
                         return
                     i = work.pop()
                 t1 = _now()
-                flags[i] = self.windows[i].generate_consensus(
-                    self.engine, self.trim)
+                flags[i], hit = self._consensus_cached(
+                    self.windows[i], _epoch)
+                if hit:
+                    # a cache lookup's wall says nothing about the
+                    # CPU engine rate: keep it out of the measurement
+                    continue
                 with lock:
                     meas["cpu_w"] += _now() - t1
                     meas["cpu_u"] += unit_of[i]
@@ -959,6 +968,11 @@ class TPUPolisher(Polisher):
         def apply(idxs, collect, record=True):
             nonlocal mark
             results = collect()
+            # cache-served windows shrink the measured wall while the
+            # unit count stays: a batch with any hits would corrupt
+            # the stored device rate, so it records nothing (r18;
+            # policy only — the demux below is identical either way)
+            record = record and not getattr(collect, "cache_hits", 0)
             # chaos site (r17): device results landed on the host but
             # the demux below has not committed them — a kill here
             # must replay this whole megabatch on restart
@@ -1053,8 +1067,8 @@ class TPUPolisher(Polisher):
                 f"(vcap {rc.get(-1, 0)}, pcap {rc.get(-2, 0)}, "
                 f"kcap {rc.get(-3, 0)})")
             def repolish(i):
-                return self.windows[i].generate_consensus(self.engine,
-                                                          self.trim)
+                return self._consensus_cached(self.windows[i],
+                                              _epoch)[0]
             cpu_flags = list(self._pool.map(repolish, failed))
             for i, f in zip(failed, cpu_flags):
                 flags[i] = f
@@ -1712,7 +1726,11 @@ class TPUPolisher(Polisher):
                 obs_trace.TRACER.add_span(
                     f"align.chunk.wfa{emax}", tally["mark"], now,
                     cat="align", args={"n": len(sub)})
-                if hasattr(self, "_align_disp"):
+                # chunks with cache-served lanes are excluded from
+                # the rate measurement: their wall covers fewer
+                # device steps than the unit count claims (r18)
+                if not getattr(coll, "cache_hits", 0) and \
+                        hasattr(self, "_align_disp"):
                     self._align_disp.append(
                         ("wfa", emax, now - tally["mark"], steps))
                 tally["mark"] = now
@@ -1811,7 +1829,10 @@ class TPUPolisher(Polisher):
                 obs_trace.TRACER.add_span(
                     f"align.chunk.band{wb}", tally["mark"], now,
                     cat="align", args={"n": len(sub)})
-                if hasattr(self, "_align_disp"):
+                # cache-served lanes: same measurement exclusion as
+                # the wfa rung above (r18)
+                if not getattr(coll, "cache_hits", 0) and \
+                        hasattr(self, "_align_disp"):
                     self._align_disp.append(
                         ("band", wb, now - tally["mark"],
                          float(sum(len(queries[i]) for i in sub))))
@@ -1968,6 +1989,33 @@ class TPUPolisher(Polisher):
                 return mesh_utils.sharded_align(self.mesh, *args, lq=lq,
                                                 lt=lt, hw=hw)
 
+        # result cache (r18): the ladder's per-pair answer depends
+        # only on (pair bytes, bucket dims, need ratio) — chunking
+        # and the memory budget only batch lanes, they never change
+        # one lane's result — so pairs already resolved in an earlier
+        # job/round skip the ladder entirely.  Unresolved lanes cache
+        # a None marker: replaying the CPU fall-through is the same
+        # decision the ladder would make again.
+        from racon_tpu import cache as rcache
+
+        cached, keys, cache = {}, [None] * len(chunk), None
+        if rcache.enabled():
+            cache = rcache.result_cache()
+            epoch = rcache.keying.engine_epoch()
+            for idx in range(len(chunk)):
+                keys[idx] = rcache.keying.scan_key(
+                    queries[idx], targets[idx], blq, blt,
+                    self.align_probe_p50, epoch)
+                v = cache.get(keys[idx])
+                if v is not rcache.MISS:
+                    cached[idx] = v
+            if cached:
+                obs_flight.FLIGHT.record(
+                    "cache_hit", unit_kind="scan", hits=len(cached),
+                    misses=len(chunk) - len(cached),
+                    items=len(chunk))
+        miss = [i for i in range(len(chunk)) if i not in cached]
+
         # overlaps the ladder cannot resolve go to the CPU aligner
         # (reference: exceeded_max_alignment_difference skip,
         # src/cuda/cudaaligner.cpp:64-72 + cudapolisher.cpp:212-216).
@@ -1977,34 +2025,48 @@ class TPUPolisher(Polisher):
         # the scan ladder runs synchronously, so its interval IS the
         # engine-busy window on backends without the Pallas kernel
         # (where the align_pallas watcher threads never run)
-        t0 = _now()
-        ops, cells, unresolved = aligner.band_align_batch(
-            queries, targets, blq, blt, dispatch=dispatch,
-            allow_full=False, mem_budget=self.align_mem_budget,
-            need_ratio=self.align_probe_p50)
-        t1 = _now()
-        obs_devutil.DEVICE_UTIL.record("align_band", t0, t1)
-        # calibration health + decision exemplar (r16): the scan
-        # ladder prices admission with the same stored "align" rate
-        # the hybrid split uses, so its chunks score drift identically
-        from racon_tpu.utils import calibrate
-        r_dev, _, _ = calibrate.get_rates(
-            "align", n_dev, float(self.DEV_NS_PER_ROW),
-            float(self.CPU_NS_PER_CELL), pin=self._calib_pin)
-        units = float(sum(len(q) for q in queries))
-        pred = calibrate.predict_chunk_wall("align", units, r_dev,
-                                            n_dev)
-        obs_calhealth.observe("align_band", pred, t1 - t0,
-                              registry=self.metrics)
-        obs_decision.DECISIONS.record(
-            "align_chunk", engine="band", rung=int(blq),
-            units=round(units, 1), predicted_s=round(pred, 6),
-            measured_s=round(t1 - t0, 6))
-        self.align_cells += cells
-        skip = set(unresolved.tolist())
+        runs_of: dict = {}
+        if miss:
+            t0 = _now()
+            ops, cells, unresolved = aligner.band_align_batch(
+                [queries[i] for i in miss],
+                [targets[i] for i in miss], blq, blt,
+                dispatch=dispatch, allow_full=False,
+                mem_budget=self.align_mem_budget,
+                need_ratio=self.align_probe_p50)
+            t1 = _now()
+            obs_devutil.DEVICE_UTIL.record("align_band", t0, t1)
+            # calibration health + decision exemplar (r16): the scan
+            # ladder prices admission with the same stored "align"
+            # rate the hybrid split uses, so its chunks score drift
+            # identically.  Units count only the lanes actually run
+            # — cache hits never pollute the rate (r18).
+            from racon_tpu.utils import calibrate
+            r_dev, _, _ = calibrate.get_rates(
+                "align", n_dev, float(self.DEV_NS_PER_ROW),
+                float(self.CPU_NS_PER_CELL), pin=self._calib_pin)
+            units = float(sum(len(queries[i]) for i in miss))
+            pred = calibrate.predict_chunk_wall("align", units, r_dev,
+                                                n_dev)
+            obs_calhealth.observe("align_band", pred, t1 - t0,
+                                  registry=self.metrics)
+            obs_decision.DECISIONS.record(
+                "align_chunk", engine="band", rung=int(blq),
+                units=round(units, 1), predicted_s=round(pred, 6),
+                measured_s=round(t1 - t0, 6))
+            self.align_cells += cells
+            skip = set(unresolved.tolist())
+            for j, i in enumerate(miss):
+                runs = None if j in skip \
+                    else aligner.ops_to_runs(ops[j])
+                runs_of[i] = runs
+                if cache is not None and keys[i] is not None:
+                    cache.put(keys[i], runs)
+        runs_of.update(cached)
         for idx, o in enumerate(chunk):
-            if idx not in skip:
-                o.cigar_runs = aligner.ops_to_runs(ops[idx])
+            runs = runs_of.get(idx)
+            if runs is not None:
+                o.cigar_runs = tuple(runs)
                 # pipelined mode: breaking points decode on the pool
                 # while the next chunk owns the device, advancing the
                 # streaming ledger (no-op when the pipeline is off)

@@ -175,7 +175,8 @@ def host_reserved_workers(n_workers: int, source: str) -> int:
 
 def predict_walls(align_s: float, poa_s: float,
                   overlap_s: float = None, concurrency: int = 1,
-                  occupancy: float = None) -> dict:
+                  occupancy: float = None,
+                  hit_ratio: float = None) -> dict:
     """Overlap-aware wall predictor for the two-stage polish.
 
     The pre-r8 budget model was additive (wall ~ align + poa): the
@@ -198,7 +199,15 @@ def predict_walls(align_s: float, poa_s: float,
     nothing and sharing degenerates to pure serialization).  Like the
     rest of the admission price this is deliberately crude -- it only
     has to keep ``RACON_TPU_SERVE_MAX_WALL_S`` honest to the right
-    order of magnitude when jobs share the device."""
+    order of magnitude when jobs share the device.
+
+    ``hit_ratio`` (r18): the observed result-cache hit ratio.  A
+    cached unit costs a lookup instead of a dispatch, so the walls
+    (predicted and shared) are discounted by the fraction of work
+    expected to be served from cache — floored at 10% of the
+    undiscounted wall, because the ratio is a trailing process-wide
+    observation, not a promise about THIS job's windows.  Policy
+    only: the discount moves admission decisions, never bytes."""
     out = {
         "additive_wall_s": round(align_s + poa_s, 3),
         "overlapped_floor_s": round(max(align_s, poa_s), 3),
@@ -220,6 +229,19 @@ def predict_walls(align_s: float, poa_s: float,
             base + (n - 1) * out["overlapped_floor_s"] / gain, 3)
         out["shared_concurrency"] = n
         out["fusion_occupancy"] = round(occ, 3)
+    if hit_ratio is not None and hit_ratio > 0:
+        hr = min(1.0, max(0.0, float(hit_ratio)))
+        discount = max(0.1, 1.0 - hr)
+        out["cache_hit_ratio"] = round(hr, 4)
+        # the floor is discounted too: a cached unit never dispatches,
+        # so the one-stage-fully-hidden minimum shrinks by the same
+        # fraction — keeping predicted >= floor an invariant of the
+        # discounted model just as it is of the undiscounted one
+        for term in ("predicted_wall_s", "shared_wall_s",
+                     "overlapped_floor_s"):
+            if term in out:
+                out["undiscounted_" + term] = out[term]
+                out[term] = round(out[term] * discount, 3)
     return out
 
 

@@ -1,0 +1,287 @@
+"""Content-addressed result cache (r18, racon_tpu/cache/).
+
+The cache's one safety contract is byte-neutrality: a hit must be
+indistinguishable from recomputation, under every tier and every
+failure mode.  Pinned here:
+
+* cache off / cold / warm / persistent-restart polishes of the same
+  inputs all emit byte-identical FASTA (the one-shot cache-off run is
+  the golden);
+* unit digests are stable within an epoch and shift when a
+  byte-affecting knob or engine config changes (and do NOT shift
+  when a policy-only knob like the cache budget changes);
+* the LRU respects its byte budget via cold-end eviction;
+* a corrupted or torn persistent segment degrades to a MISS — never
+  to wrong bytes;
+* racing fills of one key keep exactly one entry;
+* a second process (restart or fleet peer) indexes the first's
+  segments and serves its fills from disk.
+"""
+
+import os
+import struct
+import threading
+import zlib
+
+import numpy as np
+import pytest
+
+from racon_tpu import cache as rcache
+from racon_tpu.cache import codec, keying
+from racon_tpu.cache.store import MISS, ResultCache
+from racon_tpu.core.window import Window, WindowType
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache(monkeypatch):
+    """Every test starts with no live cache and the default knobs;
+    the singleton is torn down again afterwards so knob changes made
+    here never leak into other test modules."""
+    for knob in ("RACON_TPU_CACHE", "RACON_TPU_CACHE_MB",
+                 "RACON_TPU_CACHE_PERSIST"):
+        monkeypatch.delenv(knob, raising=False)
+    rcache._reset_for_tests()
+    yield
+    rcache._reset_for_tests()
+
+
+def small_window(seed=0, n_layers=4):
+    rng = np.random.default_rng(seed)
+    backbone = bytes(rng.choice(list(b"ACGT"), 60))
+    w = Window(0, 0, WindowType.TGS, backbone, b"!" * len(backbone))
+    for i in range(n_layers):
+        seq = bytes(rng.choice(list(b"ACGT"), 40))
+        w.add_layer(seq, b"#" * len(seq), i, min(i + 41, 60))
+    return w
+
+
+# -- keying --------------------------------------------------------------
+
+
+def test_digests_stable_and_content_sensitive():
+    epoch = keying.engine_epoch()
+    w = small_window(seed=1)
+    k1 = keying.poa_key("cpu", (5, -4, -8), True, w, epoch)
+    k2 = keying.poa_key("cpu", (5, -4, -8), True, small_window(seed=1),
+                        epoch)
+    assert k1 == k2 and len(k1) == keying.DIGEST_SIZE
+    # any content / config / space delta must change the key
+    assert k1 != keying.poa_key("cpu", (5, -4, -8), True,
+                                small_window(seed=2), epoch)
+    assert k1 != keying.poa_key("cpu", (3, -5, -4), True, w, epoch)
+    assert k1 != keying.poa_key("cpu", (5, -4, -8), False, w, epoch)
+    assert k1 != keying.poa_key("dev", (5, -4, -8), True, w, epoch)
+
+    q = np.frombuffer(b"ACGTACGT", np.uint8)
+    t = np.frombuffer(b"ACGAACGT", np.uint8)
+    ka = keying.wfa_key(q, t, 1024, 128, "mesh0", epoch)
+    assert ka == keying.wfa_key(q, t, 1024, 128, "mesh0", epoch)
+    assert ka != keying.wfa_key(q, t, 2048, 128, "mesh0", epoch)
+    assert ka != keying.wfa_key(t, q, 1024, 128, "mesh0", epoch)
+    kb = keying.band_key(q, t, 1024, 1024, 128, None, "mesh0", epoch)
+    assert kb != keying.band_key(q, t, 1024, 1024, 128,
+                                 np.arange(4), "mesh0", epoch)
+    ks = keying.scan_key(q, t, 1024, 1024, 0.3, epoch)
+    assert ks != keying.scan_key(q, t, 1024, 1024, 0.31, epoch)
+
+
+def test_epoch_tracks_byte_affecting_knobs_only(monkeypatch):
+    base = keying.engine_epoch()
+    # a kernel-shaping knob delta must invalidate every key
+    monkeypatch.setenv("RACON_TPU_WFA_EMAX", "4096")
+    assert keying.engine_epoch() != base
+    monkeypatch.delenv("RACON_TPU_WFA_EMAX")
+    assert keying.engine_epoch() == base
+    # the cache's own knobs and the observability planes are
+    # output-neutral: flipping them must NOT orphan entries
+    monkeypatch.setenv("RACON_TPU_CACHE_MB", "32")
+    monkeypatch.setenv("RACON_TPU_FLIGHT", "0")
+    monkeypatch.setenv("RACON_TPU_JOURNAL", "0")
+    assert keying.engine_epoch() == base
+
+
+# -- codec ---------------------------------------------------------------
+
+
+def test_codec_round_trips_and_rejects_junk():
+    values = [
+        None, True, False, 42, -7, b"ACGT", "name",
+        (b"CONS", True),
+        (np.arange(12, dtype=np.int32).reshape(3, 4), 7, 3, 1),
+        ((np.array([3, 1, 2], np.int64), np.array([0, 1, 0], np.int64)),),
+    ]
+    for v in values:
+        blob = codec.encode(v)
+        back = codec.decode(blob)
+
+        def eq(a, b):
+            if isinstance(a, np.ndarray):
+                return (isinstance(b, np.ndarray) and a.dtype == b.dtype
+                        and np.array_equal(a, b))
+            if isinstance(a, tuple):
+                return (isinstance(b, tuple) and len(a) == len(b)
+                        and all(eq(x, y) for x, y in zip(a, b)))
+            return a == b and type(a) is type(b)
+        assert eq(v, back), v
+    # decoded arrays must be ordinary writable arrays, not frozen
+    # frombuffer views (consumers mutate replay tapes in place)
+    arr = codec.decode(codec.encode(np.arange(5)))
+    arr[0] = 99
+    with pytest.raises(codec.CodecError):
+        codec.decode(b"\xffgarbage")
+    with pytest.raises(codec.CodecError):
+        codec.decode(codec.encode(b"x") + b"trailing")
+
+
+# -- LRU tier ------------------------------------------------------------
+
+
+def test_lru_respects_byte_budget():
+    blob_len = len(codec.encode(b"x" * 1000))
+    c = ResultCache(budget_bytes=blob_len * 3)
+    keys = [bytes([i]) * 32 for i in range(6)]
+    for k in keys:
+        c.put(k, b"x" * 1000)
+    st = c.stats()
+    assert st["bytes"] <= blob_len * 3
+    assert st["entries"] == 3 and st["evicts"] == 3
+    # survivors are the hot end; the cold half was evicted
+    assert all(c.get(k) is MISS for k in keys[:3])
+    assert all(c.get(k) == b"x" * 1000 for k in keys[3:])
+    # an over-budget value is refused outright, not admitted-then-purged
+    c.put(b"Z" * 32, b"y" * (blob_len * 4))
+    assert c.get(b"Z" * 32) is MISS
+
+
+def test_racing_fills_keep_one_entry():
+    c = ResultCache(budget_bytes=1 << 20)
+    key = b"k" * 32
+    barrier = threading.Barrier(8)
+
+    def fill():
+        barrier.wait()
+        c.put(key, (b"CONSENSUS", True))
+
+    threads = [threading.Thread(target=fill) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.stats()["entries"] == 1
+    assert c.get(key) == (b"CONSENSUS", True)
+
+
+# -- persistent tier -----------------------------------------------------
+
+
+def test_restart_and_fleet_peer_reuse_segments(tmp_path):
+    d = str(tmp_path / "results")
+    first = ResultCache(budget_bytes=1 << 20, persist_dir=d)
+    first.put(b"a" * 32, (b"AAA", True))
+    first.put(b"b" * 32, (np.arange(3), 1, 2, 3))
+    first.close()
+    # a restart (or a fleet peer sharing the directory) indexes the
+    # first process's segment at open and serves its fills from disk
+    second = ResultCache(budget_bytes=1 << 20, persist_dir=d)
+    assert second.get(b"a" * 32) == (b"AAA", True)
+    got = second.get(b"b" * 32)
+    assert np.array_equal(got[0], np.arange(3)) and got[1:] == (1, 2, 3)
+    assert second.stats()["disk_hits"] == 2
+    second.close()
+
+
+def test_corrupt_segment_is_a_miss_never_wrong_bytes(tmp_path):
+    d = str(tmp_path / "results")
+    w = ResultCache(budget_bytes=1 << 20, persist_dir=d)
+    w.put(b"a" * 32, b"PAYLOAD-A")
+    w.put(b"b" * 32, b"PAYLOAD-B")
+    w.close()
+    (seg,) = [os.path.join(d, n) for n in os.listdir(d)]
+    raw = bytearray(open(seg, "rb").read())
+    # flip one byte INSIDE the first data frame's blob: the frame
+    # still parses (length intact), so only the crc can catch it
+    length = struct.unpack(">I", raw[:4])[0]
+    blob_off = 4 + length + 4 + 32 + 4      # magic frame, then len+key+crc
+    raw[blob_off + 2] ^= 0xFF
+    open(seg, "wb").write(bytes(raw))
+    r = ResultCache(budget_bytes=1 << 20, persist_dir=d)
+    assert r.get(b"a" * 32) is MISS          # crc rejects, never wrong bytes
+    assert r.get(b"b" * 32) == b"PAYLOAD-B"  # later frames still intact
+    r.close()
+
+
+def test_torn_tail_tolerated(tmp_path):
+    d = str(tmp_path / "results")
+    w = ResultCache(budget_bytes=1 << 20, persist_dir=d)
+    w.put(b"a" * 32, b"PAYLOAD-A")
+    w.close()
+    (seg,) = [os.path.join(d, n) for n in os.listdir(d)]
+    with open(seg, "ab") as f:              # crash mid-append
+        f.write(struct.pack(">I", 500) + b"torn")
+    r = ResultCache(budget_bytes=1 << 20, persist_dir=d)
+    assert r.get(b"a" * 32) == b"PAYLOAD-A"
+    r.close()
+    # sanity: the crc helper used by the segment reader matches zlib
+    assert zlib.crc32(b"") == 0
+
+
+# -- end-to-end byte identity --------------------------------------------
+
+
+def fasta_bytes(polished):
+    return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                    for s in polished)
+
+
+def polish_once(reads, paf, draft):
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+
+    pol = create_polisher(
+        reads, paf, draft, PolisherType.kC, 500, 10.0, 0.3, True,
+        5, -4, -8, num_threads=4, tpu_poa_batches=1,
+        tpu_aligner_batches=1)
+    pol.initialize()
+    return fasta_bytes(pol.polish(True))
+
+
+def test_cache_tiers_are_byte_neutral(tmp_path, monkeypatch):
+    """The acceptance pin: cache off (golden) vs cold vs warm vs
+    persistent-restart polishes of one dataset are byte-identical,
+    and the warm/persistent runs actually hit."""
+    import tempfile
+
+    from racon_tpu.obs import REGISTRY
+    from racon_tpu.tools import simulate
+
+    with tempfile.TemporaryDirectory(prefix="racon_cachee2e_") as tmp:
+        reads, paf, draft = simulate.simulate(
+            tmp, genome_len=15_000, coverage=6, read_len=1_000,
+            seed=33, ont=True)
+
+        monkeypatch.setenv("RACON_TPU_CACHE", "0")
+        golden = polish_once(reads, paf, draft)
+
+        monkeypatch.setenv("RACON_TPU_CACHE", "1")
+        rcache._reset_for_tests()
+        cold = polish_once(reads, paf, draft)
+        assert cold == golden, "cache-on (cold) bytes differ from golden"
+
+        h0 = REGISTRY.value("cache_hit")
+        warm = polish_once(reads, paf, draft)
+        assert warm == golden, "cache-on (warm) bytes differ from golden"
+        assert REGISTRY.value("cache_hit") > h0, \
+            "warm repeat produced no cache hits"
+
+        # persistent tier: fill in one incarnation, restart, serve
+        monkeypatch.setenv("RACON_TPU_CACHE_PERSIST",
+                           str(tmp_path / "results"))
+        rcache._reset_for_tests()
+        filled = polish_once(reads, paf, draft)
+        assert filled == golden
+        rcache._reset_for_tests()       # simulated restart: fresh LRU
+        d0 = rcache.result_cache().stats().get("disk_hits", 0)
+        restarted = polish_once(reads, paf, draft)
+        assert restarted == golden, \
+            "persistent-restart bytes differ from golden"
+        assert rcache.result_cache().stats()["disk_hits"] > d0, \
+            "restart produced no disk hits: segments were not reused"
