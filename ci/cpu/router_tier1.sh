@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Fleet-router chaos lane (ISSUE 15 CI satellite): runs the router
+# suite — breaker state machine, load/price placement ranking,
+# retry_after_s pricing + client honoring, spillover, and the
+# acceptance pin: with the router fronting two daemons, SIGKILL of
+# the placed backend at EVERY r17 fault site is invisible to the
+# client (byte-identical FASTA via failover under the same job_key,
+# exactly-once through the survivor's journal), and SIGKILL of the
+# ROUTER at its own fault sites stays exactly-once on retry.
+# The multi-daemon tests are @pytest.mark.slow — the tier-1 sweep
+# (-m 'not slow') keeps only the fast in-process/unit tests, so this
+# lane (no marker filter) is where the kill matrices run.
+# Hardening mirrors the durable lane:
+#   * JAX_PLATFORMS=cpu + 8 virtual devices (tests/conftest.py)
+#     exercises the sharded dispatch path without hardware;
+#   * the journal is pinned ON — exactly-once failover is a journal
+#     property, so a stray RACON_TPU_JOURNAL=0 must not silently
+#     downgrade the chaos pins to at-least-once;
+#   * PYTHONDEVMODE=1 surfaces unclosed probe/proxy sockets across
+#     the kill/failover cycles;
+#   * pytest's faulthandler timeout dumps every thread's traceback
+#     if a failover hangs — a router stuck mid-round shows up as a
+#     stack dump naming the blocked wait, not an opaque timeout.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export PYTHONDEVMODE=1
+export RACON_TPU_JOURNAL=1
+unset RACON_TPU_FAULT || true
+python -m pytest tests/test_router.py -q \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"

@@ -60,6 +60,13 @@ EPOCH_EXCLUDE = frozenset({
     "RACON_TPU_FLEET_INTERVAL_S",
     "RACON_TPU_FLEET_TIMEOUT_S",
     "RACON_TPU_FLEET_STALE_S",
+    # fleet router (r19): placement policy — which backend runs a
+    # job never changes the job's bytes
+    "RACON_TPU_ROUTE_PROBE_S",
+    "RACON_TPU_ROUTE_PROBE_TIMEOUT_S",
+    "RACON_TPU_ROUTE_BREAKER_FAILS",
+    "RACON_TPU_ROUTE_BREAKER_COOLDOWN_S",
+    "RACON_TPU_ROUTE_TCP",
 })
 
 DIGEST_SIZE = 32

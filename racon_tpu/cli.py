@@ -27,6 +27,7 @@ from racon_tpu.io.parsers import (MalformedInputError,
 
 USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequences>
        racon-tpu serve --socket PATH [options ...]
+       racon-tpu route --socket PATH --backends S1,S2,.. [--tcp HOST:PORT]
        racon-tpu submit --socket PATH [options ...] <sequences> <overlaps> <target sequences>
        racon-tpu status --socket PATH [--json]
        racon-tpu top (--socket PATH | --fleet S1,S2,..) [--interval S] [--once] [--json]
@@ -36,6 +37,12 @@ USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequenc
 
     subcommands (racon_tpu/serve — persistent polishing service):
         serve    start the warm-kernel job daemon on a unix socket
+        route    start a fault-tolerant router fronting several
+                 serve daemons: health-probed placement, spillover
+                 on backpressure, per-backend circuit breakers, and
+                 exactly-once crash failover (idempotent job keys +
+                 journal dedup); --tcp adds a host-crossing TCP
+                 listener with the same framed protocol
         submit   run one polish through a daemon (same options and
                  stdout contract as the one-shot form; --trace FILE
                  saves the job's server-side trace slice;
@@ -261,6 +268,9 @@ def main(argv=None):
     if argv and argv[0] == "serve":
         from racon_tpu.serve import server as serve_server
         raise SystemExit(serve_server.main(argv[1:]))
+    if argv and argv[0] == "route":
+        from racon_tpu.serve import router as serve_router
+        raise SystemExit(serve_router.main(argv[1:]))
     if argv and argv[0] == "submit":
         from racon_tpu.serve import client as serve_client
         raise SystemExit(serve_client.main_submit(argv[1:]))

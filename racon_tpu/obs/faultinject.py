@@ -18,6 +18,13 @@ Sites in use (racon_tpu/serve + racon_tpu/tpu/polisher):
 * ``pre-done-record`` — job finished, done record never journaled
 * ``journal-write``   — inside the journal append, before the write
 
+Router sites (r19, racon_tpu/serve/router.py — arm them on the
+ROUTER process to kill it around a placement, the complement of
+killing a backend under the router):
+
+* ``route-pre-forward`` — placement chosen, job not yet forwarded
+* ``route-pre-reply``   — backend answered, reply not yet sent
+
 Counting is per-process and lock-guarded, so ``<site>:<nth>`` is
 deterministic under concurrent workers.  An unarmed site costs one
 env read and returns; production runs never set the knob (registered
@@ -32,7 +39,8 @@ import sys
 import threading
 
 SITES = ("post-admit", "mid-megabatch", "pre-demux",
-         "pre-done-record", "journal-write")
+         "pre-done-record", "journal-write",
+         "route-pre-forward", "route-pre-reply")
 
 _lock = threading.Lock()
 _counts: dict = {}

@@ -105,6 +105,16 @@ KNOWN_KNOBS = {
     "RACON_TPU_JOURNAL_DIR": "",
     "RACON_TPU_JOURNAL_FSYNC": "1",
     "RACON_TPU_FAULT": "",
+    # fleet router (r19, racon_tpu/serve/router.py): health-probe
+    # period/timeout, circuit-breaker open threshold + cooldown, and
+    # the optional TCP bind.  Placement policy only — which backend
+    # runs a job never changes the job's bytes, so cache/keying.py
+    # EXCLUDES all of these from the engine epoch.
+    "RACON_TPU_ROUTE_PROBE_S": "1.0",
+    "RACON_TPU_ROUTE_PROBE_TIMEOUT_S": "2.0",
+    "RACON_TPU_ROUTE_BREAKER_FAILS": "3",
+    "RACON_TPU_ROUTE_BREAKER_COOLDOWN_S": "5.0",
+    "RACON_TPU_ROUTE_TCP": "",
     # result cache (r18, racon_tpu/cache/): content-addressed unit
     # memoization off-switch, in-process LRU budget in MB, and the
     # shared persistent tier ("1" = <cache_root()>/results, any other

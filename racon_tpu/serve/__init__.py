@@ -30,6 +30,12 @@ server, applied to polishing:
   (racon_tpu/obs/aggregate.py), multiplexed ``watch`` streams, and
   the ``racon-tpu metrics`` one-shot CLI; ``racon-tpu top --fleet``
   renders the merged view.
+* :mod:`racon_tpu.serve.router` — the r19 fault-tolerance tier: a
+  ``racon-tpu route`` daemon fronting N serve daemons with
+  health-probed cost-ranked placement, spillover on backpressure,
+  per-backend circuit breakers, draining-aware + crash failover
+  (exactly-once via idempotent job keys and the r17 journal dedup),
+  and an optional TCP listener speaking the same framed protocol.
 
 Determinism contract: a served job's FASTA is byte-identical to a
 standalone CLI run with the same inputs/flags/threads/devices — the

@@ -33,19 +33,54 @@ class ServeError(RuntimeError):
     """Transport-level failure (no server, protocol violation)."""
 
 
-def request(socket_path: str, frame: dict, timeout: float = None):
-    """One request/response round trip.  ``timeout`` bounds every
-    socket operation; submits block for the whole job, so the
-    default is no timeout."""
+def is_tcp_address(addr: str) -> bool:
+    """``host:port`` addressing (r19 TCP front, racon_tpu/serve/
+    router.py): no path separator, a colon, and an all-digits port.
+    Anything else — including every existing unix-socket path — keeps
+    the unix-domain behaviour, so the rule is backward-compatible by
+    construction."""
+    if not addr or "/" in addr or os.path.exists(addr):
+        return False
+    host, sep, port = addr.rpartition(":")
+    return bool(sep) and bool(host) and port.isdigit()
+
+
+def _connect(addr: str, timeout: float = None):
+    """Dial ``addr`` — a unix-socket path, or ``host:port`` for the
+    router's TCP front — and return the connected socket.  Raises
+    OSError on failure (callers wrap into :class:`ServeError`)."""
+    if is_tcp_address(addr):
+        host, _, port = addr.rpartition(":")
+        sock = socket.socket(socket.AF_INET)
+        sock.settimeout(timeout)
+        try:
+            sock.connect((host, int(port)))
+        except OSError:
+            sock.close()
+            raise
+        return sock
     sock = socket.socket(socket.AF_UNIX)
     sock.settimeout(timeout)
     try:
-        try:
-            sock.connect(socket_path)
-        except OSError as exc:
-            raise ServeError(
-                f"cannot reach server at {socket_path} ({exc})"
-            ) from exc
+        sock.connect(addr)
+    except OSError:
+        sock.close()
+        raise
+    return sock
+
+
+def request(socket_path: str, frame: dict, timeout: float = None):
+    """One request/response round trip against a unix socket path or
+    a ``host:port`` TCP front.  ``timeout`` bounds every socket
+    operation; submits block for the whole job, so the default is no
+    timeout."""
+    try:
+        sock = _connect(socket_path, timeout)
+    except OSError as exc:
+        raise ServeError(
+            f"cannot reach server at {socket_path} ({exc})"
+        ) from exc
+    try:
         try:
             protocol.send_frame(sock, frame)
             resp = protocol.recv_frame(sock)
@@ -101,12 +136,20 @@ def submit_with_retry(socket_path: str, spec: dict,
     make the retries idempotent by contract: a retry that lands
     after the original was admitted joins the SAME job, and one that
     lands after a daemon crash is answered from the journal record
-    instead of re-running."""
+    instead of re-running.
+
+    When the reject carries a server-supplied ``retry_after_s`` hint
+    (r19: the scheduler prices it from its own observed exec walls
+    and queue state), that hint wins over the blind exponential
+    schedule — the server knows when a slot will actually free.  The
+    jittered ``0.5·2^n`` schedule stays as the fallback for
+    transport errors and hint-less rejects."""
     import random
     import time
 
     attempt = 0
     while True:
+        hint = None
         try:
             resp = submit(socket_path, spec, priority=priority,
                           timeout=timeout, want_trace=want_trace,
@@ -117,13 +160,21 @@ def submit_with_retry(socket_path: str, spec: dict,
                 raise
             reason = str(exc)
         else:
-            code = (resp.get("error") or {}).get("code")
+            err = resp.get("error") or {}
+            code = err.get("code")
             if resp.get("ok") or code not in RETRYABLE \
                     or attempt >= retries:
                 return resp
             reason = code
-        delay = min(30.0, 0.5 * (2 ** attempt))
-        delay *= 0.5 + random.random()
+            try:
+                hint = float(err["retry_after_s"])
+            except (KeyError, TypeError, ValueError):
+                hint = None
+        if hint is not None and hint > 0:
+            delay = min(30.0, hint) * (0.75 + 0.5 * random.random())
+        else:
+            delay = min(30.0, 0.5 * (2 ** attempt))
+            delay *= 0.5 + random.random()
         attempt += 1
         print(f"[racon_tpu::submit] retryable failure ({reason}); "
               f"attempt {attempt}/{retries} in {delay:.1f}s",
@@ -148,6 +199,14 @@ def metrics(socket_path: str, timeout: float = 30.0) -> dict:
 def health(socket_path: str, timeout: float = 30.0) -> dict:
     """Cheap liveness/readiness document."""
     return request(socket_path, {"op": "health"}, timeout=timeout)
+
+
+def route_status(socket_path: str, timeout: float = 30.0) -> dict:
+    """Router-detail document (the r19 ``route_status`` op): per
+    backend breaker state / probe staleness / queue depth, plus the
+    router's spillover/failover counters.  Only routers answer it."""
+    return request(socket_path, {"op": "route_status"},
+                   timeout=timeout)
 
 
 def flight(socket_path: str, job=None, last: int = 0,
@@ -183,15 +242,13 @@ def watch(socket_path: str, interval_s: float = 1.0, count: int = 0,
     Yields one dict per frame; ends when the server sent ``count``
     frames (0 = unbounded), drained, or the connection dropped.
     Closing the generator closes the connection."""
-    sock = socket.socket(socket.AF_UNIX)
-    sock.settimeout(timeout)
     try:
-        try:
-            sock.connect(socket_path)
-        except OSError as exc:
-            raise ServeError(
-                f"cannot reach server at {socket_path} ({exc})"
-            ) from exc
+        sock = _connect(socket_path, timeout)
+    except OSError as exc:
+        raise ServeError(
+            f"cannot reach server at {socket_path} ({exc})"
+        ) from exc
+    try:
         try:
             protocol.send_frame(sock, {"op": "watch",
                                        "interval_s": interval_s,
@@ -351,6 +408,41 @@ def main_submit(argv) -> int:
     return 0
 
 
+def _print_router_status(doc: dict) -> int:
+    """Human rendering of a router ``status``/``route_status`` doc:
+    per-backend breaker state (CLOSED/OPEN/HALF-OPEN), probe
+    staleness, and the router's routing counters."""
+    tcp = f" + tcp {doc['tcp']}" if doc.get("tcp") else ""
+    print(f"router      pid {doc.get('pid')} on "
+          f"{doc.get('socket')}{tcp}")
+    state = "draining" if doc.get("draining") else "routing"
+    print(f"state       {state}, up {doc.get('uptime_s', 0):.1f}s, "
+          f"{doc.get('in_flight', 0)} in flight")
+    c = doc.get("counters") or {}
+    print(f"routing     {c.get('route_submit', 0)} submit(s), "
+          f"{c.get('route_spillover', 0)} spillover(s), "
+          f"{c.get('route_failover', 0)} failover(s), "
+          f"{c.get('route_dedup_joins', 0)} dedup join(s)")
+    backends = doc.get("backends") or []
+    if backends:
+        print("backend                           breaker    fails  "
+              "probe     queue  run  state")
+    for b in backends:
+        age = b.get("probe_age_s")
+        probe = "never" if age is None else f"{age:5.1f}s"
+        if b.get("stale"):
+            probe += "!"
+        qd = b.get("queue_depth")
+        run = b.get("running")
+        state = "draining" if b.get("draining") else (
+            "down" if b.get("breaker") != "CLOSED" else "up")
+        print(f"{b.get('target', '?'):<33s} {b.get('breaker'):<9s}  "
+              f"{b.get('failures', 0):>5d}  {probe:<8s}  "
+              f"{qd if qd is not None else '-':>5}  "
+              f"{run if run is not None else '-':>3}  {state}")
+    return 0
+
+
 def main_status(argv) -> int:
     socket_path, _, _, _, _, _, rest = _split_serve_flags(argv)
     as_json = "--json" in rest
@@ -368,6 +460,8 @@ def main_status(argv) -> int:
         json.dump(doc, sys.stdout, indent=1)
         print()
         return 0
+    if doc.get("router"):
+        return _print_router_status(doc)
     q = doc.get("queue", {})
     state = ("draining" if doc.get("draining")
              else "paused" if q.get("paused") else "running")

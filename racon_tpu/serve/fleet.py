@@ -221,6 +221,10 @@ def merge_fleet(rows) -> dict:
             "running": len(q.get("running", ())),
             "completed": q.get("completed"),
             "draining": q.get("draining"),
+            # r19: a router target's metrics doc carries its backend
+            # breaker rows + routing counters; plain daemons carry
+            # none — `top --fleet` renders the block when present
+            "route": doc.get("route"),
         })
         snap = doc.get("snapshot")
         if snap:

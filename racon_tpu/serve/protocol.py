@@ -135,6 +135,34 @@ Result cache (r18, racon_tpu/cache/):
   binary body (32-byte key + crc32 + codec blob) — see
   racon_tpu/cache/store.py (``racon-tpu-rcache-v1``) and the
   ``RACON_TPU_CACHE*`` knobs.
+
+Fleet routing (r19, racon_tpu/serve/router.py):
+
+* The framing is transport-agnostic by construction (both helpers
+  below take any connected socket object), and r19 uses that: a
+  ``racon-tpu route`` router speaks the SAME frames on its unix
+  socket and on an optional TCP listener (``--tcp HOST:PORT`` /
+  ``RACON_TPU_ROUTE_TCP``), so clients address a router as
+  ``host:port`` with no protocol change (racon_tpu/serve/client.py
+  picks the address family from the address's shape).
+* ``route_status`` — router-only op: per-backend circuit-breaker
+  state (``CLOSED``/``OPEN``/``HALF-OPEN``), consecutive failures,
+  probe staleness, draining flags, and the router's
+  ``route_submit``/``route_spillover``/``route_failover``/
+  ``route_dedup_joins`` counters.  A router's ``status`` answers the
+  same document, flagged ``router: true`` so ``racon-tpu status``
+  renders it as a router.  Routers also answer ``health`` /
+  ``metrics`` / ``flight`` / ``shutdown`` in the daemon shapes
+  (``metrics`` adds a ``route`` block), and proxy ``submit``
+  verbatim — placement, spillover and crash failover are invisible
+  in the response apart from an added ``routed_backend`` field.
+* ``queue_full`` / ``draining`` reject objects now carry
+  ``retry_after_s`` — the server's own estimate of when a retry can
+  admit, priced from its observed exec walls and queue state.
+  Clients (``submit_with_retry``) and the router's spillover loop
+  prefer the hint over their blind exponential schedules; the
+  jittered schedule remains the fallback.  A router that exhausts
+  every backend answers the code ``no_backend``.
 """
 
 from __future__ import annotations

@@ -1,0 +1,887 @@
+"""Fault-tolerant fleet router: one address in front of N daemons.
+
+``racon-tpu route --socket PATH --backends S1,S2,... [--tcp H:P]``
+starts a routing daemon that fronts several ``racon-tpu serve``
+backends so a single daemon crash, drain, or full queue is no longer
+a client-visible outage — the online, crash-tolerant lift of the
+reference wrapper's offline split driver (racon_wrapper.py), and the
+fault-tolerance layer of the ROADMAP's fleet-scale serving item.
+
+* **Health-probed placement** — a background loop (period
+  ``RACON_TPU_ROUTE_PROBE_S``) sends the cheap ``health`` op to every
+  backend, keeping per-backend queue depth / running count /
+  draining state fresh (the r15 ``FleetScraper`` pattern: last good
+  doc retained, staleness visible).  Every submit is priced per
+  backend via :func:`scheduler.estimate_job` — the same
+  ``calibrate.predict_walls`` model the daemons' own admission uses,
+  including the r13 shared-wall concurrency term (this backend's
+  live load + 1) and the r18 hit-ratio discount — and placed on the
+  backend with the lowest predicted wall (ties: lowest load, then
+  CLI list order, so placement is deterministic under equal load).
+* **Spillover** — a backend's retryable reject (``queue_full``,
+  ``job_too_large``, ``draining``) is not surfaced: the router tries
+  the next-best backend, and only when EVERY eligible backend
+  rejected does it sleep (preferring the servers' ``retry_after_s``
+  hints over its own backoff) and re-rank for another round.
+* **Circuit breakers** — consecutive probe/submit failures
+  (``RACON_TPU_ROUTE_BREAKER_FAILS``) flip a backend OPEN: it stops
+  receiving placements and probes until a jittered cooldown
+  (``RACON_TPU_ROUTE_BREAKER_COOLDOWN_S``) elapses, then ONE
+  half-open probe decides — success closes the breaker, failure
+  re-opens it.  A dead socket costs one connect per cooldown window,
+  not per submit.
+* **Draining-aware failover** — a SIGTERM'd backend answers probes
+  with ``status: draining``; the router marks it and routes new jobs
+  elsewhere while the backend's in-flight jobs (including ones this
+  router placed) finish undisturbed — mirroring the daemon's own
+  drain contract.
+* **Crash failover, exactly-once** — a backend that dies mid-job
+  surfaces as a transport error on the blocked submit; the router
+  resubmits to a surviving backend under the SAME idempotence
+  ``job_key`` (client-supplied, or router-derived when the client
+  sent none).  The r17 write-ahead journal dedups any replay of the
+  dead backend's work, and byte-determinism makes the surviving
+  backend's bytes identical — so the crash is invisible to the
+  client (pinned by tests/test_router.py's chaos matrix).  Completed
+  keys stay sticky: a duplicate keyed submit routes to the backend
+  that ran it, whose journal answers from the record.
+* **TCP front** — ``--tcp HOST:PORT`` (or ``RACON_TPU_ROUTE_TCP``)
+  additionally listens on TCP with the SAME length-prefixed JSON
+  framing (racon_tpu/serve/protocol.py works on any socket object),
+  so clients are no longer confined to the router's host.  ``PORT``
+  0 binds an ephemeral port, reported in ``route_status``.
+
+Every routing decision is observable: ``route_submit`` /
+``route_spillover`` / ``route_failover`` / ``route_dedup_joins``
+counters and ``route_breaker_open.<backend>`` per-backend counters
+in the registry, plus a flight event per decision
+(``route`` / ``route_spillover`` / ``route_failover`` /
+``route_breaker`` / ``route_dedup``) so ``racon-tpu inspect``
+reconstructs why a job landed where it did.  The ``route_status``
+op (also rendered by ``racon-tpu status``) reports per-backend
+breaker state, probe staleness and the counters.
+
+Knobs (all placement policy — none can change job bytes, so all are
+``EPOCH_EXCLUDE``'d from cache keys):
+
+* ``RACON_TPU_ROUTE_PROBE_S``            probe period (1.0)
+* ``RACON_TPU_ROUTE_PROBE_TIMEOUT_S``    per-probe timeout (2.0)
+* ``RACON_TPU_ROUTE_BREAKER_FAILS``      failures to OPEN (3)
+* ``RACON_TPU_ROUTE_BREAKER_COOLDOWN_S`` OPEN -> half-open (5.0)
+* ``RACON_TPU_ROUTE_TCP``                TCP bind, "" = off
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import random
+import signal
+import socket
+import sys
+import threading
+
+from racon_tpu.obs import REGISTRY
+from racon_tpu.obs import context as obs_context
+from racon_tpu.obs import faultinject
+from racon_tpu.obs import flight as obs_flight
+from racon_tpu.obs import trace as obs_trace
+from racon_tpu.serve import client, protocol
+
+
+def eprint(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def probe_interval_s() -> float:
+    return max(0.05, _env_float("RACON_TPU_ROUTE_PROBE_S", 1.0))
+
+
+def probe_timeout_s() -> float:
+    return max(0.1, _env_float("RACON_TPU_ROUTE_PROBE_TIMEOUT_S", 2.0))
+
+
+def breaker_fails() -> int:
+    return max(1, _env_int("RACON_TPU_ROUTE_BREAKER_FAILS", 3))
+
+
+def breaker_cooldown_s() -> float:
+    return max(0.1,
+               _env_float("RACON_TPU_ROUTE_BREAKER_COOLDOWN_S", 5.0))
+
+
+#: breaker states (route_status renders them uppercase)
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+#: spillover rounds before the router gives up and surfaces the
+#: last retryable reject (each round re-ranks and re-tries every
+#: eligible backend, sleeping on the servers' retry_after_s between)
+_MAX_ROUNDS = 3
+
+#: cap on the inter-round spillover sleep
+_MAX_ROUND_WAIT_S = 10.0
+
+
+class Backend:
+    """One fronted daemon: last-known health + its circuit breaker.
+
+    The breaker is a small explicit state machine — CLOSED (normal),
+    OPEN (shed: no placements, no probes until ``next_probe``),
+    HALF-OPEN (cooldown elapsed; exactly one probe in flight decides)
+    — with every transition under one lock and time injected by the
+    caller, so the transitions unit-test without a daemon or a
+    sleep."""
+
+    def __init__(self, target: str, fails: int = None,
+                 cooldown_s: float = None):
+        self.target = target
+        self._fails_limit = breaker_fails() if fails is None else fails
+        self._cooldown_s = (breaker_cooldown_s()
+                            if cooldown_s is None else cooldown_s)
+        self._lock = threading.Lock()
+        self.state = CLOSED
+        self.failures = 0          # consecutive probe/submit failures
+        self.draining = False
+        self.health = None         # last good health doc
+        self.t_health = None       # ... and when it arrived
+        self.last_error = None
+        self.next_probe = 0.0      # earliest half-open probe (OPEN)
+        self.opened_count = 0
+        self._probing = False      # a half-open probe is in flight
+
+    def note_success(self, doc: dict, now: float) -> bool:
+        """A probe answered: refresh health, close the breaker.
+        Returns True when this CLOSED a non-closed breaker."""
+        with self._lock:
+            reopened = self.state != CLOSED
+            self.state = CLOSED
+            self.failures = 0
+            self.health = doc
+            self.t_health = now
+            self.last_error = None
+            self._probing = False
+            self.draining = (doc or {}).get("status") == "draining" \
+                or not (doc or {}).get("accepting", True)
+            return reopened
+
+    def note_failure(self, error: str, now: float) -> bool:
+        """A probe or submit transport-failed.  Returns True when
+        this OPENED the breaker (the caller records/announces it —
+        once per opening, not per failure)."""
+        with self._lock:
+            self.failures += 1
+            self.last_error = error
+            self._probing = False
+            if self.state == HALF_OPEN or (
+                    self.state == CLOSED
+                    and self.failures >= self._fails_limit):
+                self.state = OPEN
+                # jittered cooldown: a fleet of routers (or breakers)
+                # must not re-probe a recovering daemon in lockstep
+                self.next_probe = now + self._cooldown_s * (
+                    0.75 + 0.5 * random.random())
+                self.opened_count += 1
+                return True
+            return False
+
+    def probe_due(self, now: float) -> bool:
+        """Whether the probe loop should probe this backend now.
+        CLOSED probes every round; OPEN waits out the cooldown, then
+        admits exactly ONE half-open probe."""
+        with self._lock:
+            if self._probing:
+                return False
+            if self.state == CLOSED:
+                return True
+            if now >= self.next_probe:
+                self.state = HALF_OPEN
+                self._probing = True
+                return True
+            return False
+
+    def eligible(self) -> bool:
+        """May receive a NEW placement: breaker closed, not
+        draining."""
+        with self._lock:
+            return self.state == CLOSED and not self.draining
+
+    def mark_draining(self) -> None:
+        with self._lock:
+            self.draining = True
+
+    def load(self) -> int:
+        """Queued + running jobs from the last good health doc (0
+        when never probed — optimism costs one spillover, pessimism
+        would blackhole a fresh backend)."""
+        with self._lock:
+            h = self.health or {}
+        try:
+            return int(h.get("queue_depth") or 0) + \
+                int(h.get("running") or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def snapshot(self, now: float) -> dict:
+        with self._lock:
+            h = self.health or {}
+            age = None if self.t_health is None \
+                else round(now - self.t_health, 3)
+            return {
+                "target": self.target,
+                "breaker": self.state.upper(),
+                "failures": self.failures,
+                "opened_count": self.opened_count,
+                "draining": self.draining,
+                "probe_age_s": age,
+                "queue_depth": h.get("queue_depth"),
+                "running": h.get("running"),
+                "daemon_pid": h.get("pid"),
+                "last_error": self.last_error,
+            }
+
+
+class _RoutedJob:
+    """In-router rendezvous for one idempotence key: concurrent
+    duplicate submits join the owner's routing instead of racing two
+    placements for one key."""
+
+    def __init__(self, job_key: str):
+        self.job_key = job_key
+        self.done = threading.Event()
+        self.response = None
+
+
+class FleetRouter:
+    def __init__(self, socket_path: str, backends,
+                 tcp: str = None):
+        if not backends:
+            raise ValueError("FleetRouter needs at least one backend")
+        self.socket_path = socket_path
+        self.backends = [Backend(t) for t in backends]
+        self.tcp_spec = tcp or None
+        self.probe_interval = probe_interval_s()
+        self.probe_timeout = probe_timeout_s()
+        self._sock = None
+        self._tcp_sock = None
+        self.tcp_addr = None         # actual host:port once bound
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._handlers: list = []
+        self._in_flight = 0          # live proxied submits
+        self._live: dict = {}        # job_key -> _RoutedJob
+        self._done_backend: dict = {}  # job_key -> backend target
+        self._keyseq = itertools.count(1)
+        self._t_start = obs_trace.now()
+        self._drain_logged = False
+        obs_flight.FLIGHT.install_dump_on_crash()
+        from racon_tpu.obs import provenance
+        provenance.daemon_identity(socket_path)
+        REGISTRY.set("route_backends", len(self.backends))
+
+    def _identity(self) -> dict:
+        from racon_tpu.obs import provenance
+        return provenance.daemon_identity(self.socket_path)
+
+    # -- health probing / breakers -------------------------------------
+
+    def _probe_one(self, backend: Backend) -> None:
+        try:
+            doc = client.health(backend.target,
+                                timeout=self.probe_timeout)
+            ok = bool(doc.get("ok"))
+            error = None if ok else "health answered ok=false"
+        except Exception as exc:    # ServeError or anything transport
+            doc, ok = None, False
+            error = f"{type(exc).__name__}: {exc}"
+        now = obs_trace.now()
+        if ok:
+            closed = backend.note_success(doc, now)
+            REGISTRY.set(f"route_backend_up.{backend.target}", 1)
+            if closed:
+                obs_flight.FLIGHT.record(
+                    "route_breaker", backend=backend.target,
+                    state="closed")
+                eprint(f"[racon_tpu::route] breaker CLOSED for "
+                       f"{backend.target} (half-open probe answered)")
+        else:
+            opened = backend.note_failure(error, now)
+            REGISTRY.set(f"route_backend_up.{backend.target}", 0)
+            if opened:
+                self._record_breaker_open(backend, error)
+
+    def _record_breaker_open(self, backend: Backend,
+                             error: str) -> None:
+        REGISTRY.add(f"route_breaker_open.{backend.target}")
+        obs_flight.FLIGHT.record(
+            "route_breaker", backend=backend.target, state="open",
+            failures=backend.failures, error=(error or "")[:200])
+        eprint(f"[racon_tpu::route] breaker OPEN for "
+               f"{backend.target} after {backend.failures} "
+               f"consecutive failure(s): {error}")
+
+    def _probe_round(self) -> None:
+        """One concurrent probe round over every due backend (the
+        FleetScraper shape: one bounded thread per target, last good
+        doc retained on failure)."""
+        now = obs_trace.now()
+        due = [b for b in self.backends if b.probe_due(now)]
+        threads = [threading.Thread(target=self._probe_one, args=(b,),
+                                    daemon=True) for b in due]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self.probe_timeout + 5.0)
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval):
+            self._probe_round()
+
+    # -- placement -----------------------------------------------------
+
+    def _price(self, spec: dict, concurrency: int):
+        """Predicted wall for this job at this backend's load — the
+        daemons' own admission model (scheduler.estimate_job ->
+        calibrate.predict_walls with shared-wall + hit-ratio terms).
+        None when the inputs cannot be priced from here (e.g. a
+        TCP-remote client naming paths this host cannot stat) —
+        ranking then falls back to raw load."""
+        from racon_tpu.serve import scheduler
+        try:
+            return scheduler.estimate_job(spec,
+                                          concurrency=concurrency)
+        except (OSError, KeyError, TypeError, ValueError):
+            return None
+
+    def _rank(self, spec: dict, exclude=()) -> list:
+        """Eligible backends, best placement first: (predicted wall,
+        load, CLI list order) — the last term makes placement
+        deterministic under equal load."""
+        rows = []
+        for idx, backend in enumerate(self.backends):
+            if backend.target in exclude or not backend.eligible():
+                continue
+            load = backend.load()
+            est = self._price(spec, load + 1)
+            wall = None
+            if est:
+                wall = est.get("shared_wall_s",
+                               est.get("predicted_wall_s"))
+            rows.append((wall if wall is not None else float("inf"),
+                         load, idx, backend, est))
+        rows.sort(key=lambda r: (r[0], r[1], r[2]))
+        return [(backend, est) for _, _, _, backend, est in rows]
+
+    # -- submit proxying -----------------------------------------------
+
+    def _handle_submit(self, req: dict) -> dict:
+        spec = req.get("job")
+        if not isinstance(spec, dict):
+            return protocol.error_frame(
+                "bad_request", "submit carries no job object")
+        job_key = req.get("job_key")
+        if job_key is not None and \
+                not obs_context.valid_trace_id(job_key):
+            return protocol.error_frame(
+                "bad_request",
+                "job_key must be 1..128 chars of "
+                "[A-Za-z0-9._:-] starting alphanumeric")
+        if self._stop.is_set():
+            REGISTRY.add("route_reject.draining")
+            return protocol.error_frame(
+                "draining", "router is draining: in-flight jobs "
+                "finish, new jobs are rejected", retry_after_s=2.0)
+        if job_key is None:
+            # failover safety net: the resubmit after a backend crash
+            # must carry the SAME key as the original placement, or
+            # the surviving backend could re-run work the dead one's
+            # journal already recorded
+            job_key = f"route-{os.getpid()}-{next(self._keyseq)}"
+        # in-router rendezvous: concurrent duplicates of one key join
+        # the owner's routing (one placement, every caller gets the
+        # same response) — the router-level twin of the scheduler's
+        # _by_key rendezvous
+        with self._lock:
+            live = self._live.get(job_key)
+            owner = live is None
+            if owner:
+                live = _RoutedJob(job_key)
+                self._live[job_key] = live
+        if not owner:
+            REGISTRY.add("route_dedup_joins")
+            obs_flight.FLIGHT.record("route_dedup", job_key=job_key,
+                                     joined="live")
+            live.done.wait()
+            return live.response
+        try:
+            resp = self._route_job(spec, req, job_key)
+        except Exception as exc:     # router bug: job fails, router
+            obs_flight.FLIGHT.record_exception(   # survives
+                "route_error", exc)
+            resp = protocol.error_frame(
+                "job_failed", f"router error: {exc}",
+                type=type(exc).__name__)
+        with self._lock:
+            self._live.pop(job_key, None)
+            if resp.get("ok") and resp.get("routed_backend"):
+                # completed keys stay sticky to the backend whose
+                # journal holds the record, so a late duplicate is
+                # answered by THAT journal (dedup, not re-run)
+                self._done_backend[job_key] = resp["routed_backend"]
+        faultinject.hit("route-pre-reply")
+        live.response = resp
+        live.done.set()
+        return resp
+
+    def _route_job(self, spec: dict, req: dict,
+                   job_key: str) -> dict:
+        priority = int(req.get("priority", 0))
+        dead = set()          # backends that transport-failed: never
+        last_reject = None    # retried for THIS job this round-trip
+        sticky = self._done_backend.get(job_key)
+        for round_no in range(_MAX_ROUNDS):
+            hint = None
+            ranked = self._rank(spec, exclude=dead)
+            if sticky is not None:
+                # a completed key's duplicate goes back to the
+                # recording backend first (stable sort keeps the
+                # cost order for the rest)
+                ranked.sort(key=lambda row:
+                            0 if row[0].target == sticky else 1)
+            for backend, est in ranked:
+                faultinject.hit("route-pre-forward")
+                REGISTRY.add("route_submit")
+                obs_flight.FLIGHT.record(
+                    "route", job_key=job_key, backend=backend.target,
+                    round=round_no, load=backend.load(),
+                    predicted_wall_s=(round(est.get(
+                        "shared_wall_s",
+                        est.get("predicted_wall_s", 0.0)), 4)
+                        if est else None))
+                try:
+                    resp = client.submit(
+                        backend.target, spec, priority=priority,
+                        want_trace=bool(req.get("trace")),
+                        trace_context=req.get("trace_context"),
+                        job_key=job_key)
+                except client.ServeError as exc:
+                    # the backend died (possibly mid-job): crash
+                    # failover — feed the breaker and resubmit the
+                    # SAME key to the next survivor; the r17 journal
+                    # dedup makes the retry exactly-once
+                    if backend.note_failure(str(exc),
+                                            obs_trace.now()):
+                        self._record_breaker_open(backend, str(exc))
+                    REGISTRY.add("route_failover")
+                    obs_flight.FLIGHT.record(
+                        "route_failover", job_key=job_key,
+                        backend=backend.target,
+                        error=str(exc)[:200])
+                    eprint(f"[racon_tpu::route] backend "
+                           f"{backend.target} failed mid-submit "
+                           f"({exc}); failing over")
+                    dead.add(backend.target)
+                    continue
+                err = (resp.get("error") or {}) \
+                    if not resp.get("ok") else {}
+                code = err.get("code")
+                if code in ("queue_full", "job_too_large",
+                            "draining"):
+                    # retryable elsewhere: spill to the next-best
+                    # backend instead of surfacing the reject
+                    if code == "draining":
+                        backend.mark_draining()
+                    REGISTRY.add("route_spillover")
+                    obs_flight.FLIGHT.record(
+                        "route_spillover", job_key=job_key,
+                        backend=backend.target, code=code)
+                    try:
+                        h = float(err["retry_after_s"])
+                        hint = h if hint is None else min(hint, h)
+                    except (KeyError, TypeError, ValueError):
+                        pass
+                    last_reject = resp
+                    continue
+                # success, or a reject that is the CLIENT's to see
+                # (bad_request / input_not_found / job_failed —
+                # another backend would answer the same)
+                out = dict(resp)
+                out["routed_backend"] = backend.target
+                return out
+            if round_no + 1 < _MAX_ROUNDS and not self._stop.is_set():
+                # every eligible backend rejected retryably: honor
+                # the servers' retry_after_s hints (min over the
+                # round) before re-ranking, jittered — fall back to
+                # doubling when no server sent one
+                delay = hint if hint is not None and hint > 0 \
+                    else 0.5 * (2 ** round_no)
+                delay = min(_MAX_ROUND_WAIT_S, delay) * (
+                    0.75 + 0.5 * random.random())
+                self._stop.wait(delay)
+        if last_reject is not None:
+            out = dict(last_reject)
+            return out
+        REGISTRY.add("route_reject.no_backend")
+        return protocol.error_frame(
+            "no_backend",
+            "no live backend accepted the job "
+            f"({len(self.backends)} configured)",
+            backends=[b.snapshot(obs_trace.now())["breaker"]
+                      for b in self.backends])
+
+    # -- status / telemetry docs ---------------------------------------
+
+    def _route_doc(self) -> dict:
+        """The ``route_status`` / ``status`` document: per-backend
+        breaker + staleness rows, routing counters, listener
+        addresses.  ``router: true`` is what clients key rendering
+        off."""
+        now = obs_trace.now()
+        stale_after = 3 * self.probe_interval + self.probe_timeout
+        rows = []
+        for backend in self.backends:
+            row = backend.snapshot(now)
+            row["stale"] = (row["probe_age_s"] is None
+                            or row["probe_age_s"] > stale_after)
+            rows.append(row)
+        snap = REGISTRY.snapshot()
+        counters = {k: v for k, v in snap["counters"].items()
+                    if k.startswith("route_")}
+        with self._lock:
+            in_flight = self._in_flight
+            done_keys = len(self._done_backend)
+        return {
+            "ok": True,
+            "router": True,
+            "pid": os.getpid(),
+            "socket": self.socket_path,
+            "tcp": self.tcp_addr,
+            "identity": self._identity(),
+            "uptime_s": round(now - self._t_start, 3),
+            "draining": self._stop.is_set(),
+            "in_flight": in_flight,
+            "routed_keys": done_keys,
+            "probe_interval_s": self.probe_interval,
+            "backends": rows,
+            "counters": counters,
+        }
+
+    def _health_doc(self) -> dict:
+        up = sum(1 for b in self.backends if b.eligible())
+        with self._lock:
+            in_flight = self._in_flight
+        return {
+            "ok": True,
+            "router": True,
+            "status": ("draining" if self._stop.is_set() else "ok"),
+            "accepting": not self._stop.is_set(),
+            "pid": os.getpid(),
+            "identity": self._identity(),
+            "uptime_s": round(obs_trace.now() - self._t_start, 3),
+            "backends": len(self.backends),
+            "backends_up": up,
+            "in_flight_jobs": in_flight,
+            "queue_depth": 0,
+            "running": in_flight,
+        }
+
+    def _metrics_doc(self) -> dict:
+        """Router telemetry in the daemon ``metrics`` shape (identity
+        + snapshot + prometheus) so a FleetScraper/``top --fleet``
+        over routers and daemons merges without special cases; the
+        ``route`` block carries the breaker rows for rendering."""
+        from racon_tpu.obs import export
+        REGISTRY.set("route_uptime_s",
+                     round(obs_trace.now() - self._t_start, 3))
+        snap = REGISTRY.snapshot()
+        doc = self._route_doc()
+        return {
+            "ok": True,
+            "router": True,
+            "pid": os.getpid(),
+            "identity": self._identity(),
+            "uptime_s": doc["uptime_s"],
+            "route": {"backends": doc["backends"],
+                      "counters": doc["counters"],
+                      "in_flight": doc["in_flight"],
+                      "draining": doc["draining"],
+                      "tcp": doc["tcp"]},
+            "snapshot": export.json_snapshot(snap),
+            "prometheus": export.prometheus_text(snap),
+        }
+
+    def _flight_doc(self, req: dict) -> dict:
+        try:
+            last = int(req.get("last", 0) or 0)
+        except (TypeError, ValueError):
+            return protocol.error_frame(
+                "bad_request", "flight: last must be an integer")
+        return {
+            "ok": True,
+            "pid": os.getpid(),
+            "identity": self._identity(),
+            "ring": obs_flight.FLIGHT.stats(),
+            "events": obs_flight.FLIGHT.snapshot(last=last),
+        }
+
+    # -- connection handling -------------------------------------------
+
+    def _serve_connection(self, conn) -> None:
+        try:
+            req = protocol.recv_frame(conn)
+            if req is None:
+                return
+            op = req.get("op") if isinstance(req, dict) else None
+            if op == "submit":
+                with self._lock:
+                    self._in_flight += 1
+                try:
+                    resp = self._handle_submit(req)
+                finally:
+                    with self._lock:
+                        self._in_flight -= 1
+            elif op in ("status", "route_status"):
+                resp = self._route_doc()
+            elif op == "health":
+                resp = self._health_doc()
+            elif op == "metrics":
+                resp = self._metrics_doc()
+            elif op == "flight":
+                resp = self._flight_doc(req)
+            elif op == "shutdown":
+                resp = {"ok": True, "draining": True}
+                self._stop.set()
+            else:
+                resp = protocol.error_frame(
+                    "bad_request", f"unknown op {op!r} (router)")
+            protocol.send_frame(conn, resp)
+        except protocol.ProtocolError as exc:
+            REGISTRY.add("route_bad_frames")
+            try:
+                protocol.send_frame(conn, protocol.error_frame(
+                    "bad_request", str(exc)))
+            except OSError:
+                pass
+        except OSError:
+            pass   # client went away mid-reply; nothing to salvage
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _spawn_handler(self, conn) -> None:
+        t = threading.Thread(target=self._serve_connection,
+                             args=(conn,), daemon=True,
+                             name="racon-route-conn")
+        self._handlers.append(t)
+        t.start()
+        self._handlers = [h for h in self._handlers if h.is_alive()]
+
+    def _tcp_accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._tcp_sock.accept()
+            except socket.timeout:
+                if self._stop.is_set() and self._idle():
+                    return
+                continue
+            except OSError:
+                return
+            self._spawn_handler(conn)
+
+    def _idle(self) -> bool:
+        with self._lock:
+            return self._in_flight == 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _peer_alive(self):
+        """Takeover probe (same proof as the daemon's): True =
+        answered a health frame (alive), False = connection refused
+        (provably dead), None = ambiguous (refuse takeover)."""
+        probe = socket.socket(socket.AF_UNIX)
+        probe.settimeout(5.0)
+        try:
+            probe.connect(self.socket_path)
+        except ConnectionRefusedError:
+            return False
+        except OSError:
+            return None
+        try:
+            protocol.send_frame(probe, {"op": "health"})
+            resp = protocol.recv_frame(probe)
+            return True if isinstance(resp, dict) else None
+        except (protocol.ProtocolError, OSError):
+            return None
+        finally:
+            try:
+                probe.close()
+            except OSError:
+                pass
+
+    def _bind_tcp(self) -> None:
+        host, _, port = self.tcp_spec.rpartition(":")
+        host = host or "127.0.0.1"
+        self._tcp_sock = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._tcp_sock.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._tcp_sock.bind((host, int(port)))
+        self._tcp_sock.listen(16)
+        self._tcp_sock.settimeout(0.25)
+        bound = self._tcp_sock.getsockname()
+        self.tcp_addr = f"{bound[0]}:{bound[1]}"
+
+    def serve_forever(self) -> int:
+        if os.path.exists(self.socket_path):
+            alive = self._peer_alive()
+            if alive:
+                eprint(f"[racon_tpu::route] error: a live server "
+                       f"already owns {self.socket_path}; refusing "
+                       f"to take over")
+                return 1
+            if alive is None:
+                eprint(f"[racon_tpu::route] error: cannot prove the "
+                       f"owner of {self.socket_path} dead; refusing "
+                       f"to take over — remove the socket manually "
+                       f"if the process is gone")
+                return 1
+            eprint(f"[racon_tpu::route] stale socket "
+                   f"{self.socket_path}: previous owner is dead, "
+                   f"taking over")
+            os.unlink(self.socket_path)
+        # one synchronous probe round BEFORE accepting: the first
+        # submit places against real health, not optimistic zeros
+        self._probe_round()
+        self._sock = socket.socket(socket.AF_UNIX)
+        self._sock.bind(self.socket_path)
+        self._sock.listen(16)
+        self._sock.settimeout(0.25)
+        if self.tcp_spec:
+            try:
+                self._bind_tcp()
+            except (OSError, ValueError) as exc:
+                eprint(f"[racon_tpu::route] error: cannot bind TCP "
+                       f"front {self.tcp_spec!r}: {exc}")
+                self._sock.close()
+                try:
+                    os.unlink(self.socket_path)
+                except OSError:
+                    pass
+                return 1
+            threading.Thread(target=self._tcp_accept_loop,
+                             daemon=True,
+                             name="racon-route-tcp").start()
+        threading.Thread(target=self._probe_loop, daemon=True,
+                         name="racon-route-probe").start()
+        up = sum(1 for b in self.backends if b.eligible())
+        eprint(f"[racon_tpu::route] routing on {self.socket_path}"
+               + (f" + tcp {self.tcp_addr}" if self.tcp_addr else "")
+               + f" -> {len(self.backends)} backend(s), {up} up "
+               f"(probe every {self.probe_interval}s)")
+        try:
+            while True:
+                if self._stop.is_set():
+                    if not self._drain_logged:
+                        self._drain_logged = True
+                        eprint("[racon_tpu::route] draining: "
+                               "finishing in-flight jobs, rejecting "
+                               "new ones")
+                        obs_flight.FLIGHT.record(
+                            "drain", in_flight=self._in_flight)
+                    if self._idle():
+                        break
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                self._spawn_handler(conn)
+        finally:
+            self._shutdown()
+        return 0
+
+    def _shutdown(self) -> None:
+        self._stop.set()
+        # let blocked submit proxies flush their replies
+        for h in list(self._handlers):
+            h.join(timeout=10)
+        for sock in (self._sock, self._tcp_sock):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        if obs_flight.enabled():
+            try:
+                path = obs_flight.FLIGHT.dump(reason="route-drain")
+                eprint(f"[racon_tpu::route] flight dump: {path}")
+            except OSError as exc:
+                eprint(f"[racon_tpu::route] flight dump failed: "
+                       f"{exc}")
+        eprint(f"[racon_tpu::route] drained "
+               f"({REGISTRY.value('route_submit')} placement(s)); "
+               f"bye")
+
+    def request_stop(self, *_sig) -> None:
+        self._stop.set()
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="racon-tpu route",
+        description="Fault-tolerant router fronting several "
+        "racon-tpu serve daemons: health-probed placement, "
+        "spillover on backpressure, circuit breakers, and "
+        "exactly-once crash failover via idempotent job keys.")
+    p.add_argument("--socket", required=True,
+                   help="unix-domain socket path to listen on")
+    p.add_argument("--backends", required=True,
+                   metavar="SOCK1,SOCK2,...",
+                   help="comma-separated backend daemon sockets "
+                   "(or host:port TCP fronts)")
+    p.add_argument("--tcp", default=None, metavar="HOST:PORT",
+                   help="additionally listen on TCP (same framed "
+                   "protocol; port 0 = ephemeral, reported in "
+                   "route_status).  Default RACON_TPU_ROUTE_TCP "
+                   "or off")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    backends = [t for t in args.backends.split(",") if t]
+    if not backends:
+        eprint("[racon_tpu::route] error: --backends needs at least "
+               "one socket")
+        return 1
+    tcp = args.tcp if args.tcp is not None \
+        else (os.environ.get("RACON_TPU_ROUTE_TCP") or None)
+    router = FleetRouter(args.socket, backends, tcp=tcp)
+    signal.signal(signal.SIGTERM, router.request_stop)
+    signal.signal(signal.SIGINT, router.request_stop)
+    return router.serve_forever()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,6 +94,21 @@ def _observed_hit_ratio() -> float:
     return hits / total if total else 0.0
 
 
+def _retry_after_hint_s(pending: int, max_jobs: int) -> float:
+    """Server-priced backoff hint for retryable rejects (r19).
+
+    The mean observed exec wall (``serve_exec_wall_s``) divided by
+    the worker count approximates the drain rate, so ``pending``
+    jobs clear in about ``mean * pending / max_jobs`` seconds.
+    Before any job has run the mean is unknown; 1 s stands in.
+    Clamped to 0.25..30 s — the hint guides a retry schedule, it is
+    not a promise."""
+    h = REGISTRY.snapshot()["histograms"].get("serve_exec_wall_s")
+    mean = h["sum"] / h["count"] if h and h.get("count") else 1.0
+    return round(min(30.0, max(
+        0.25, mean * max(1, pending) / max(1, max_jobs))), 3)
+
+
 def estimate_job(spec: dict, concurrency: int = 1) -> dict:
     """Price a submission from input stats alone.
 
@@ -350,7 +365,10 @@ class JobScheduler:
                 raise RejectError({
                     "code": "draining",
                     "reason": "server is draining: running jobs "
-                              "finish, new jobs are rejected"})
+                              "finish, new jobs are rejected",
+                    "retry_after_s": _retry_after_hint_s(
+                        len(self._heap) + len(self._running),
+                        self.max_jobs)})
             if len(self._heap) >= self.max_queue:
                 REGISTRY.add("serve_reject.queue_full")
                 raise RejectError({
@@ -358,7 +376,10 @@ class JobScheduler:
                     "reason": "job queue is at capacity; retry later",
                     "queue_depth": len(self._heap),
                     "max_queue": self.max_queue,
-                    "running": len(self._running)})
+                    "running": len(self._running),
+                    # one slot must free before a retry can admit
+                    "retry_after_s": _retry_after_hint_s(
+                        1, self.max_jobs)})
             if job_key is not None:
                 # re-check under the admission lock: two concurrent
                 # NEW submits with the same key must admit once

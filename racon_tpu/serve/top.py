@@ -157,10 +157,14 @@ def render_fleet(doc: dict) -> str:
         ident = d.get("identity") or {}
         did = (ident.get("daemon_id") or d.get("target", "?"))[:12]
         pid = str(ident.get("pid") or "-")
+        route = d.get("route")
         if not ident:
             state = "DOWN"       # never answered: no identity known
         elif d.get("stale"):
             state = "STALE"
+        elif route:
+            state = ("draining" if route.get("draining")
+                     else "router")
         elif d.get("draining"):
             state = "draining"
         else:
@@ -176,6 +180,28 @@ def render_fleet(doc: dict) -> str:
             f"{'-' if done is None else done!s:>4s}")
         if d.get("error") and state in ("DOWN", "STALE"):
             lines.append(f"              ! {d['error']}")
+        if route and state not in ("DOWN", "STALE"):
+            # r19: one sub-row per fronted backend — breaker state
+            # (CLOSED/OPEN/HALF-OPEN), consecutive failures, probe
+            # staleness — plus the routing counters
+            c = route.get("counters") or {}
+            lines.append(
+                f"              route: "
+                f"{c.get('route_submit', 0)} placed, "
+                f"{c.get('route_spillover', 0)} spilled, "
+                f"{c.get('route_failover', 0)} failed over, "
+                f"{route.get('in_flight', 0)} in flight")
+            for b in route.get("backends", ()):
+                age = b.get("probe_age_s")
+                probe = "never" if age is None else f"{age:.1f}s"
+                if b.get("stale"):
+                    probe += " STALE"
+                flags = " draining" if b.get("draining") else ""
+                lines.append(
+                    f"              -> {b.get('target', '?')}  "
+                    f"{b.get('breaker')}"
+                    f"  fails {b.get('failures', 0)}"
+                    f"  probe {probe}{flags}")
 
     slo = doc.get("slo") or {}
     if slo:

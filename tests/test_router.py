@@ -1,0 +1,1008 @@
+"""Fault-tolerant fleet router (racon_tpu/serve/router.py) — ISSUE 15.
+
+The contract under test:
+
+* **breaker state machine** — CLOSED -> (N consecutive failures) ->
+  OPEN -> (jittered cooldown) -> HALF-OPEN single probe -> CLOSED or
+  back to OPEN, with time injected so the transitions test without a
+  daemon or a sleep.
+* **placement** — eligible backends rank by (predicted wall, load,
+  CLI list order); unstatable inputs fall back to load; OPEN /
+  draining backends never receive placements.
+* **retry_after_s** — ``queue_full``/``draining`` rejects carry the
+  server-priced hint, and ``submit_with_retry`` prefers it over the
+  blind exponential schedule.
+* **router mechanics in-process** — spillover on a full backend,
+  sticky completed keys, ``route_status``/TCP-front parity, breaker
+  open/close on probe evidence, ``no_backend`` exhaustion, drain.
+* **chaos matrix (slow)** — two real backends behind a real router:
+  SIGKILL of the first-ranked backend at EVERY r17 fault site is
+  invisible to the client (byte-identical to the one-shot CLI,
+  exactly-once via the surviving backend's journal dedup); SIGKILL
+  of the ROUTER at its own fault sites stays exactly-once through
+  the backend journal; draining and ``job_too_large`` backends fail
+  over; the wrapper's ``--server`` takes a router address and a
+  degraded daemon list.
+
+Chaos runs reuse the durable-suite dataset/golden fixtures and the
+pinned-rate environment (tests/test_durable.py) so placement pricing,
+the split, and the output bytes are deterministic.
+"""
+
+import base64
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from racon_tpu.serve import client  # noqa: E402
+from racon_tpu.serve import protocol  # noqa: E402
+from racon_tpu.serve import router  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# breaker state machine (clock injected — no sleeps, no daemon)
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_machine():
+    b = router.Backend("x", fails=2, cooldown_s=10.0)
+    assert b.state == router.CLOSED and b.eligible()
+    assert b.probe_due(100.0)                 # CLOSED probes always
+
+    assert not b.note_failure("boom", 100.0)  # 1st failure: CLOSED
+    assert b.state == router.CLOSED
+    assert b.note_failure("boom", 101.0)      # 2nd: OPENs (returns True once)
+    assert b.state == router.OPEN and not b.eligible()
+    assert b.opened_count == 1
+    # jittered cooldown lands in [0.75, 1.25] x 10s
+    assert 101.0 + 7.5 <= b.next_probe <= 101.0 + 12.5
+
+    assert not b.probe_due(b.next_probe - 5.0)   # still cooling
+    assert b.probe_due(b.next_probe + 0.1)       # -> HALF-OPEN
+    assert b.state == router.HALF_OPEN
+    assert not b.probe_due(b.next_probe + 0.2)   # exactly ONE probe
+
+    # half-open failure re-opens immediately (no fails-limit wait)
+    assert b.note_failure("still down", b.next_probe + 1.0)
+    assert b.state == router.OPEN and b.opened_count == 2
+
+    # recovery: cooldown out, half-open probe succeeds -> CLOSED
+    assert b.probe_due(b.next_probe + 0.1)
+    closed = b.note_success(
+        {"ok": True, "status": "ok", "accepting": True}, 200.0)
+    assert closed                              # closed a non-closed breaker
+    assert b.state == router.CLOSED and b.failures == 0
+    assert b.eligible()
+
+    # a draining health doc keeps the breaker closed but the backend
+    # ineligible for NEW placements
+    assert not b.note_success({"ok": True, "status": "draining"}, 201.0)
+    assert b.state == router.CLOSED
+    assert b.draining and not b.eligible()
+
+    snap = b.snapshot(202.0)
+    assert snap["breaker"] == "CLOSED" and snap["draining"]
+    assert snap["opened_count"] == 2
+    assert snap["probe_age_s"] == 1.0
+
+
+def test_rank_orders_by_load_then_list_order(tmp_path):
+    r = router.FleetRouter(str(tmp_path / "r.sock"),
+                           ["a.sock", "b.sock", "c.sock"])
+    now = 10.0
+    healthy = {"ok": True, "status": "ok", "accepting": True}
+    r.backends[0].note_success(dict(healthy, queue_depth=2, running=1),
+                               now)
+    r.backends[1].note_success(dict(healthy, queue_depth=0, running=0),
+                               now)
+    r.backends[2].note_success(dict(healthy, queue_depth=0, running=0),
+                               now)
+    # unstatable inputs -> pricing unavailable -> rank by raw load,
+    # ties broken by CLI list order (deterministic placement)
+    spec = {"sequences": "/nope", "overlaps": "/nope",
+            "targets": "/nope"}
+    ranked = [b.target for b, _ in r._rank(spec)]
+    assert ranked == ["b.sock", "c.sock", "a.sock"]
+    # exclusion (crash failover's dead set) drops a backend
+    ranked = [b.target for b, _ in r._rank(spec, exclude={"b.sock"})]
+    assert ranked == ["c.sock", "a.sock"]
+    # draining and OPEN backends are ineligible
+    r.backends[2].mark_draining()
+    for _ in range(router.breaker_fails()):
+        r.backends[1].note_failure("down", now)
+    assert [b.target for b, _ in r._rank(spec)] == ["a.sock"]
+
+
+def test_rank_prices_statable_specs(tmp_path):
+    reads = tmp_path / "r.fasta"
+    reads.write_text(">r1\nACGTACGTACGT\n")
+    paf = tmp_path / "o.paf"
+    paf.write_text("r1\t12\t0\t12\t+\tt1\t12\t0\t12\t12\t12\t255\n")
+    draft = tmp_path / "t.fasta"
+    draft.write_text(">t1\nACGTACGTACGT\n")
+    r = router.FleetRouter(str(tmp_path / "r.sock"), ["a", "b"])
+    now = 1.0
+    for b in r.backends:
+        b.note_success({"ok": True, "status": "ok", "accepting": True,
+                        "queue_depth": 0, "running": 0}, now)
+    spec = {"sequences": str(reads), "overlaps": str(paf),
+            "targets": str(draft)}
+    ranked = r._rank(spec)
+    assert [b.target for b, _ in ranked] == ["a", "b"]
+    for _, est in ranked:
+        assert est is not None and "predicted_wall_s" in est
+
+
+# ---------------------------------------------------------------------------
+# retry_after_s: server pricing + client honoring
+# ---------------------------------------------------------------------------
+
+def test_scheduler_rejects_carry_retry_after(tmp_path):
+    from racon_tpu.serve import scheduler as sched
+
+    reads = tmp_path / "r.fasta"
+    reads.write_text(">r1\nACGT\n")
+    paf = tmp_path / "o.paf"
+    paf.write_text("r1\t4\t0\t4\t+\tt1\t4\t0\t4\t4\t4\t255\n")
+    draft = tmp_path / "t.fasta"
+    draft.write_text(">t1\nACGT\n")
+    spec = {"sequences": str(reads), "overlaps": str(paf),
+            "targets": str(draft)}
+    s = sched.JobScheduler(runner=lambda job: {"ok": True},
+                           max_queue=1, max_jobs=2)
+    s.pause()                       # workers hold -> the queue fills
+    s.submit(spec)
+    with pytest.raises(sched.RejectError) as exc:
+        s.submit(spec)
+    err = exc.value.error
+    assert err["code"] == "queue_full"
+    assert 0.25 <= err["retry_after_s"] <= 30.0
+    s.start_drain()
+    with pytest.raises(sched.RejectError) as exc:
+        s.submit(spec)
+    err = exc.value.error
+    assert err["code"] == "draining"
+    assert 0.25 <= err["retry_after_s"] <= 30.0
+
+
+def test_retry_after_hint_pricing():
+    from racon_tpu.obs import REGISTRY
+    from racon_tpu.serve.scheduler import _retry_after_hint_s
+
+    # clamps hold with or without observed walls
+    assert _retry_after_hint_s(0, 8) >= 0.25
+    assert _retry_after_hint_s(10 ** 9, 1) == 30.0
+    REGISTRY.observe("serve_exec_wall_s", 4.0)
+    REGISTRY.observe("serve_exec_wall_s", 4.0)
+    h = REGISTRY.snapshot()["histograms"]["serve_exec_wall_s"]
+    mean = h["sum"] / h["count"]
+    expected = round(min(30.0, max(0.25, mean * 6 / 2)), 3)
+    assert _retry_after_hint_s(6, 2) == expected
+    # more pending never prices a SHORTER wait
+    assert _retry_after_hint_s(6, 2) >= _retry_after_hint_s(1, 2)
+
+
+def test_submit_with_retry_honors_server_hint(monkeypatch):
+    import time as _time
+
+    delays = []
+    monkeypatch.setattr(_time, "sleep", lambda s: delays.append(s))
+    responses = [
+        {"ok": False, "error": {"code": "queue_full",
+                                "retry_after_s": 0.01}},
+        {"ok": False, "error": {"code": "queue_full",
+                                "retry_after_s": 0.01}},
+        {"ok": True, "job_id": 1},
+    ]
+    monkeypatch.setattr(client, "submit",
+                        lambda *a, **k: responses.pop(0))
+    resp = client.submit_with_retry("/nope.sock", {}, retries=5)
+    assert resp["ok"] and not responses
+    # the 0.01s hint (x 0.75..1.25 jitter) wins over the 0.5s blind
+    # base — the server knows when a slot frees, the client doesn't
+    assert len(delays) == 2
+    for d in delays:
+        assert 0.0075 <= d <= 0.0125, delays
+
+    # hint-less rejects keep the jittered exponential fallback
+    delays.clear()
+    responses[:] = [{"ok": False, "error": {"code": "draining"}},
+                    {"ok": True, "job_id": 2}]
+    resp = client.submit_with_retry("/nope.sock", {}, retries=5)
+    assert resp["ok"]
+    assert len(delays) == 1 and 0.25 <= delays[0] <= 0.75, delays
+
+
+# ---------------------------------------------------------------------------
+# address-family rule, fault sites, knob registration
+# ---------------------------------------------------------------------------
+
+def test_is_tcp_address(tmp_path):
+    assert client.is_tcp_address("127.0.0.1:8080")
+    assert client.is_tcp_address("localhost:0")
+    assert client.is_tcp_address("router.example.com:9000")
+    # every unix-socket shape keeps unix-domain behaviour
+    assert not client.is_tcp_address("/tmp/serve.sock")
+    assert not client.is_tcp_address("rel/dir/serve.sock")
+    assert not client.is_tcp_address("serve.sock")
+    assert not client.is_tcp_address(":8080")       # empty host
+    assert not client.is_tcp_address("8080")        # no separator
+    assert not client.is_tcp_address("host:p0rt")   # non-numeric port
+    assert not client.is_tcp_address("")
+    # an EXISTING file always wins as a path, whatever its name
+    weird = tmp_path / "9:9"
+    weird.write_text("")
+    assert not client.is_tcp_address(str(weird))
+
+
+def test_faultinject_route_sites(monkeypatch):
+    from racon_tpu.obs import faultinject
+
+    assert "route-pre-forward" in faultinject.SITES
+    assert "route-pre-reply" in faultinject.SITES
+    monkeypatch.setenv("RACON_TPU_FAULT", "route-pre-forward:2")
+    assert faultinject.spec() == ("route-pre-forward", 2)
+    monkeypatch.setenv("RACON_TPU_FAULT", "route-pre-reply")
+    assert faultinject.spec() == ("route-pre-reply", 1)
+    monkeypatch.delenv("RACON_TPU_FAULT")
+    faultinject._reset_for_tests()
+
+
+def test_route_knobs_registered_and_epoch_excluded(monkeypatch):
+    from racon_tpu.cache import keying
+    from racon_tpu.obs import provenance
+
+    names = ["RACON_TPU_ROUTE_PROBE_S",
+             "RACON_TPU_ROUTE_PROBE_TIMEOUT_S",
+             "RACON_TPU_ROUTE_BREAKER_FAILS",
+             "RACON_TPU_ROUTE_BREAKER_COOLDOWN_S",
+             "RACON_TPU_ROUTE_TCP"]
+    for n in names:
+        assert n in provenance.KNOWN_KNOBS, n
+        assert n in keying.EPOCH_EXCLUDE, n
+        monkeypatch.delenv(n, raising=False)
+    base = keying.engine_epoch()
+    # routing knobs are placement policy: they must never move the
+    # result-cache epoch (which would orphan every cached unit)
+    monkeypatch.setenv("RACON_TPU_ROUTE_PROBE_S", "0.123")
+    monkeypatch.setenv("RACON_TPU_ROUTE_TCP", "127.0.0.1:9999")
+    assert keying.engine_epoch() == base
+    # ...while a compute-shaping knob does (mechanism sanity check)
+    monkeypatch.setenv("RACON_TPU_POA_MEGABATCH", "7919")
+    assert keying.engine_epoch() != base
+
+
+# ---------------------------------------------------------------------------
+# status rendering (satellite: status/top render router state)
+# ---------------------------------------------------------------------------
+
+def _router_doc(**over):
+    doc = {
+        "ok": True, "router": True, "pid": 42, "socket": "/r.sock",
+        "tcp": "127.0.0.1:9100", "uptime_s": 12.5, "draining": False,
+        "in_flight": 1, "routed_keys": 3, "probe_interval_s": 1.0,
+        "backends": [
+            {"target": "/a.sock", "breaker": "OPEN", "failures": 4,
+             "opened_count": 1, "draining": False, "probe_age_s": 0.4,
+             "stale": False, "queue_depth": None, "running": None,
+             "last_error": "connection refused"},
+            {"target": "/b.sock", "breaker": "CLOSED", "failures": 0,
+             "opened_count": 0, "draining": True, "probe_age_s": None,
+             "stale": True, "queue_depth": 2, "running": 1,
+             "last_error": None},
+        ],
+        "counters": {"route_submit": 7, "route_spillover": 2,
+                     "route_failover": 1, "route_dedup_joins": 1},
+    }
+    doc.update(over)
+    return doc
+
+
+def test_print_router_status_rendering(capsys):
+    assert client._print_router_status(_router_doc()) == 0
+    out = capsys.readouterr().out
+    assert "router      pid 42 on /r.sock + tcp 127.0.0.1:9100" in out
+    assert "routing     7 submit(s), 2 spillover(s), 1 failover(s)," \
+        in out
+    assert "/a.sock" in out and "OPEN" in out and "down" in out
+    assert "/b.sock" in out and "draining" in out
+    assert "never!" in out          # stale, never-probed marker
+
+
+def test_top_render_fleet_router_rows():
+    from racon_tpu.serve import top
+
+    rdoc = _router_doc()
+    doc = {"fleet_size": 1, "alive": 1, "stale": 0, "daemons": [{
+        "target": "/r.sock", "stale": False,
+        "identity": {"daemon_id": "abcdef123456", "pid": 42},
+        "uptime_s": 12.5, "queue_depth": 0, "running": 1,
+        "completed": None, "draining": False,
+        "route": {"backends": rdoc["backends"],
+                  "counters": rdoc["counters"],
+                  "in_flight": rdoc["in_flight"],
+                  "draining": False, "tcp": rdoc["tcp"]},
+    }]}
+    text = top.render_fleet(doc)
+    assert "router" in text
+    assert "7 placed" in text and "2 spilled" in text
+    assert "/a.sock" in text and "OPEN" in text
+    assert "/b.sock" in text
+
+
+# ---------------------------------------------------------------------------
+# in-process router over protocol-speaking stub backends (fast)
+# ---------------------------------------------------------------------------
+
+def _stub_backend(path, behavior):
+    """Minimal framed-protocol daemon: one request per connection,
+    ``behavior(req) -> resp``.  Returns (stop_event, listener)."""
+    s = socket.socket(socket.AF_UNIX)
+    s.bind(path)
+    s.listen(8)
+    s.settimeout(0.2)
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            try:
+                conn, _ = s.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                req = protocol.recv_frame(conn)
+                if req is not None:
+                    protocol.send_frame(conn, behavior(req))
+            except Exception:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    threading.Thread(target=loop, daemon=True).start()
+    return stop, s
+
+
+def _ok_behavior(name):
+    def behavior(req):
+        if req["op"] == "health":
+            return {"ok": True, "status": "ok", "accepting": True,
+                    "queue_depth": 0, "running": 0, "pid": 1}
+        if req["op"] == "submit":
+            return {"ok": True, "job_id": 7, "fasta_b64": "Zg==",
+                    "wall_s": 0.0, "n_sequences": 1, "who": name}
+        return {"ok": True}
+    return behavior
+
+
+def _full_behavior(req):
+    # healthy + idle on probes (so the rank tie-break places it
+    # first) but rejects every submit -> forces a real spillover
+    if req["op"] == "health":
+        return {"ok": True, "status": "ok", "accepting": True,
+                "queue_depth": 0, "running": 0, "pid": 2}
+    if req["op"] == "submit":
+        return {"ok": False, "error": {"code": "queue_full",
+                                       "reason": "full",
+                                       "retry_after_s": 0.05}}
+    return {"ok": True}
+
+
+def test_router_in_process_spillover_breakers_tcp(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_ROUTE_PROBE_S", "0.1")
+    monkeypatch.setenv("RACON_TPU_ROUTE_BREAKER_FAILS", "2")
+    monkeypatch.setenv("RACON_TPU_ROUTE_BREAKER_COOLDOWN_S", "0.5")
+    tmp = tempfile.mkdtemp(prefix="rtrt_", dir="/tmp")
+    a = os.path.join(tmp, "a.sock")
+    b = os.path.join(tmp, "b.sock")
+    rsock = os.path.join(tmp, "r.sock")
+    stop_a, sock_a = _stub_backend(a, _full_behavior)
+    stop_b, sock_b = _stub_backend(b, _ok_behavior("B"))
+    r = router.FleetRouter(rsock, [a, b], tcp="127.0.0.1:0")
+    threading.Thread(target=r.serve_forever, daemon=True).start()
+    deadline = time.monotonic() + 20
+    while not os.path.exists(rsock) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(rsock), "router socket never bound"
+    spec = {"sequences": "/nope", "overlaps": "/nope",
+            "targets": "/nope"}
+    try:
+        # spillover: A ranked first (tie -> list order), rejects
+        # queue_full, job lands on B without the client seeing it
+        resp = client.submit(rsock, spec, job_key="k1")
+        assert resp["ok"] and resp["routed_backend"] == b, resp
+        # completed keys stay sticky to the recording backend
+        resp2 = client.submit(rsock, spec, job_key="k1")
+        assert resp2["routed_backend"] == b
+
+        doc = client.route_status(rsock)
+        assert doc["router"] and doc["ok"]
+        assert {row["target"]: row["breaker"]
+                for row in doc["backends"]} == {a: "CLOSED",
+                                                b: "CLOSED"}
+        assert doc["counters"]["route_submit"] >= 2
+        assert doc["counters"]["route_spillover"] >= 1
+        assert doc["tcp"] and client.is_tcp_address(doc["tcp"])
+
+        # TCP front: same frames, same router (protocol parity)
+        tdoc = client.route_status(doc["tcp"])
+        assert tdoc["router"] and tdoc["pid"] == doc["pid"]
+        tresp = client.submit(doc["tcp"], spec, job_key="k2")
+        assert tresp["ok"] and tresp["routed_backend"] == b
+
+        # health/metrics/flight answer in the daemon shapes
+        h = client.health(rsock)
+        assert h["router"] and h["backends"] == 2
+        m = client.metrics(rsock)
+        assert m["router"] and "route" in m and "snapshot" in m
+        assert m["route"]["tcp"] == doc["tcp"]
+
+        # kill B: the only accepting backend is gone; the exhausted
+        # rounds surface the last retryable reject (A's queue_full)
+        stop_b.set()
+        sock_b.close()
+        os.unlink(b)
+        resp3 = client.submit(rsock, spec, job_key="k3")
+        assert not resp3["ok"]
+        assert resp3["error"]["code"] in ("queue_full", "no_backend")
+
+        # consecutive probe failures flip B's breaker OPEN...
+        deadline = time.monotonic() + 20
+        opened = False
+        while time.monotonic() < deadline:
+            doc = client.route_status(rsock)
+            row = [x for x in doc["backends"] if x["target"] == b][0]
+            if row["breaker"] == "OPEN":
+                opened = True
+                break
+            time.sleep(0.1)
+        assert opened, doc
+        assert doc["counters"].get(f"route_breaker_open.{b}", 0) >= 1
+
+        # ...and a half-open probe against the revived backend
+        # closes it again
+        stop_b2, sock_b2 = _stub_backend(b, _ok_behavior("B2"))
+        try:
+            deadline = time.monotonic() + 20
+            closed = False
+            while time.monotonic() < deadline:
+                doc = client.route_status(rsock)
+                row = [x for x in doc["backends"]
+                       if x["target"] == b][0]
+                if row["breaker"] == "CLOSED":
+                    closed = True
+                    break
+                time.sleep(0.1)
+            assert closed, doc
+
+            # kill BOTH backends: no reject to relay -> no_backend
+            stop_a.set()
+            sock_a.close()
+            os.unlink(a)
+            stop_b2.set()
+            sock_b2.close()
+            os.unlink(b)
+            resp4 = client.submit(rsock, spec, job_key="k4")
+            assert not resp4["ok"]
+            assert resp4["error"]["code"] == "no_backend", resp4
+
+            f = client.flight(rsock)
+            kinds = {e["kind"] for e in f["events"]}
+            assert {"route", "route_spillover", "route_failover",
+                    "route_breaker"} <= kinds, kinds
+        finally:
+            stop_b2.set()
+
+        # shutdown drains and unlinks the socket
+        assert client.admin(rsock, "shutdown")["ok"]
+        deadline = time.monotonic() + 10
+        while os.path.exists(rsock) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not os.path.exists(rsock)
+    finally:
+        stop_a.set()
+        stop_b.set()
+        r.request_stop()
+
+
+# ---------------------------------------------------------------------------
+# slow chaos suite: real daemons + real router + SIGKILL matrix
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_tmp():
+    with tempfile.TemporaryDirectory(prefix="rtrout_",
+                                     dir="/tmp") as d:
+        yield d
+
+
+@pytest.fixture(scope="module")
+def dataset(serve_tmp):
+    from racon_tpu.tools import simulate
+
+    return simulate.simulate(os.path.join(serve_tmp, "data"),
+                             genome_len=8_000, coverage=5,
+                             read_len=800, seed=21, ont=True)
+
+
+def _serve_env(serve_tmp, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "RACON_TPU_CACHE_DIR": os.path.join(serve_tmp, "cache"),
+        "RACON_TPU_CLI_PREWARM": "0",
+        # pinned rates: placement pricing and the device split are
+        # identical across backends and the golden run
+        "RACON_TPU_RATE_POA_DEV": "0.30",
+        "RACON_TPU_RATE_POA_CPU": "2.0",
+        "RACON_TPU_RATE_ALIGN_DEV": "1100",
+        "RACON_TPU_RATE_ALIGN_CPU": "4.0",
+        "RACON_TPU_RATE_ALIGN_WFA_DEV": "700",
+        "RACON_TPU_RATE_ALIGN_WFA_CPU": "1.0",
+        "RACON_TPU_POA_MEGABATCH": "1",
+    })
+    env.pop("RACON_TPU_TRACE", None)
+    env.pop("RACON_TPU_METRICS_JSON", None)
+    env.pop("RACON_TPU_FAULT", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+@pytest.fixture(scope="module")
+def golden(dataset, serve_tmp):
+    """One-shot CLI bytes — what every routed job must match."""
+    reads, paf, draft = dataset
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "4", "-c", "1",
+         "--tpualigner-batches", "1", reads, paf, draft],
+        cwd=REPO_ROOT, capture_output=True,
+        env=_serve_env(serve_tmp), timeout=600)
+    assert run.returncode == 0, run.stderr.decode()
+    assert run.stdout.startswith(b">")
+    return run.stdout
+
+
+def _spec(dataset):
+    reads, paf, draft = dataset
+    return {"sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 4, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1}
+
+
+def _wait_listening(proc, sock_path, log_path, what):
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            with open(log_path) as fh:
+                raise AssertionError(
+                    f"{what} died at startup: " + fh.read())
+        if os.path.exists(sock_path):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock_path)
+            except OSError:
+                pass
+            else:
+                return
+            finally:
+                probe.close()
+        time.sleep(0.2)
+    proc.kill()
+    raise AssertionError(f"{what} socket never came up")
+
+
+def _start_server(serve_tmp, name, args=(), extra_env=None):
+    sock_path = os.path.join(serve_tmp, name + ".sock")
+    log_path = os.path.join(serve_tmp, name + ".log")
+    log = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve",
+         "--socket", sock_path, *args],
+        cwd=REPO_ROOT, stdout=log, stderr=log,
+        env=_serve_env(serve_tmp, extra_env))
+    log.close()
+    _wait_listening(proc, sock_path, log_path, "server " + name)
+    return proc, sock_path, log_path
+
+
+def _start_router(serve_tmp, name, backends, args=(), extra_env=None):
+    sock_path = os.path.join(serve_tmp, name + ".sock")
+    log_path = os.path.join(serve_tmp, name + ".log")
+    log = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "route",
+         "--socket", sock_path,
+         "--backends", ",".join(backends), *args],
+        cwd=REPO_ROOT, stdout=log, stderr=log,
+        env=_serve_env(serve_tmp, extra_env))
+    log.close()
+    _wait_listening(proc, sock_path, log_path, "router " + name)
+    return proc, sock_path, log_path
+
+
+def _stop(proc, sock_path):
+    if proc.poll() is None:
+        try:
+            client.admin(sock_path, "shutdown")
+        except client.ServeError:
+            proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+@pytest.fixture(scope="module")
+def backend_b(serve_tmp):
+    """The surviving backend, shared across the chaos cases (each
+    case gets its own doomed backend A and its own router; B is only
+    ever the failover target, so per-case state is keyed)."""
+    proc, sock_path, _ = _start_server(serve_tmp, "shared-b")
+    yield sock_path
+    _stop(proc, sock_path)
+
+
+def _b_stats(b_sock):
+    doc = client.status(b_sock)
+    return (doc["queue"]["completed"],
+            doc["registry"]["counters"].get("serve_dedup_hits", 0))
+
+
+#: same sites as the durable suite (tests/test_durable.py): the kill
+#: lands on backend A mid-job; the router must make it invisible
+_KILL_SITES = [("post-admit", 1), ("mid-megabatch", 1),
+               ("pre-demux", 1), ("pre-done-record", 1),
+               ("journal-write", 2)]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site,nth", _KILL_SITES,
+                         ids=[s for s, _ in _KILL_SITES])
+def test_backend_sigkill_invisible_to_client(serve_tmp, dataset,
+                                             golden, backend_b,
+                                             site, nth):
+    """The r19 acceptance pin: SIGKILL of the placed backend at every
+    r17 fault site, with the router in front, is invisible — the ONE
+    client submit returns the one-shot CLI's exact bytes, and the
+    work ran exactly once (the duplicate keyed submit dedups against
+    the survivor's journal instead of re-running)."""
+    proc_a, a_sock, _ = _start_server(
+        serve_tmp, "ka-" + site,
+        extra_env={"RACON_TPU_FAULT": f"{site}:{nth}"})
+    proc_r, r_sock, _ = _start_router(serve_tmp, "kr-" + site,
+                                      [a_sock, backend_b])
+    key = f"rchaos-{site}"
+    try:
+        completed0, dedup0 = _b_stats(backend_b)
+        # both backends idle -> rank ties -> A (listed first) gets
+        # the job -> the armed site SIGKILLs it mid-job -> the router
+        # fails over to B under the SAME key, invisibly
+        resp = client.submit(r_sock, _spec(dataset), job_key=key)
+        assert resp["ok"], resp
+        assert resp["routed_backend"] == backend_b, resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden, (
+            f"failover after SIGKILL at {site} diverged from the "
+            "one-shot CLI bytes")
+        assert proc_a.wait(timeout=60) == -signal.SIGKILL
+
+        # exactly-once: the duplicate keyed submit goes back to the
+        # recording backend (sticky), whose journal answers it
+        resp2 = client.submit(r_sock, _spec(dataset), job_key=key)
+        assert resp2["ok"] and resp2["routed_backend"] == backend_b
+        assert resp2["fasta_b64"] == resp["fasta_b64"]
+        assert resp2["job_id"] == resp["job_id"]
+        completed1, dedup1 = _b_stats(backend_b)
+        assert completed1 == completed0 + 1      # ran ONCE on B
+        assert dedup1 >= dedup0 + 1              # dup answered by dedup
+
+        # the failover is observable: counter + flight event, and the
+        # dead backend's row shows the evidence
+        doc = client.route_status(r_sock)
+        assert doc["counters"].get("route_failover", 0) >= 1
+        arow = [r for r in doc["backends"] if r["target"] == a_sock][0]
+        assert arow["failures"] >= 1 or arow["breaker"] != "CLOSED"
+        kinds = {e["kind"] for e in client.flight(r_sock)["events"]}
+        assert "route_failover" in kinds and "route" in kinds
+    finally:
+        if proc_a.poll() is None:
+            proc_a.kill()
+        _stop(proc_r, r_sock)
+
+
+_ROUTE_KILL_SITES = [("route-pre-forward", 1), ("route-pre-reply", 1)]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site,nth", _ROUTE_KILL_SITES,
+                         ids=[s for s, _ in _ROUTE_KILL_SITES])
+def test_router_sigkill_exactly_once_via_journal(serve_tmp, dataset,
+                                                 golden, backend_b,
+                                                 site, nth):
+    """Killing the ROUTER at its own fault sites: the client sees the
+    transport error (the router is the client's peer), but the retry
+    through a restarted router stays exactly-once — pre-forward never
+    ran the job, pre-reply ran it and the backend journal dedups the
+    retry."""
+    name = "rkill-" + site.replace("route-", "")
+    proc_r, r_sock, _ = _start_router(
+        serve_tmp, name, [backend_b],
+        extra_env={"RACON_TPU_FAULT": f"{site}:{nth}"})
+    key = f"rk-{site}"
+    completed0, dedup0 = _b_stats(backend_b)
+    with pytest.raises(client.ServeError):
+        client.submit(r_sock, _spec(dataset), job_key=key)
+    assert proc_r.wait(timeout=300) == -signal.SIGKILL
+
+    # restart on the same (now stale) socket: the takeover proof
+    # fires, and the keyed retry lands exactly once
+    proc_r2, _, log2 = _start_router(serve_tmp, name, [backend_b])
+    try:
+        resp = client.submit(r_sock, _spec(dataset), job_key=key)
+        assert resp["ok"] and resp["routed_backend"] == backend_b
+        assert base64.b64decode(resp["fasta_b64"]) == golden
+        completed1, dedup1 = _b_stats(backend_b)
+        assert completed1 == completed0 + 1, (
+            f"job ran {completed1 - completed0} times through a "
+            f"router SIGKILL at {site}")
+        if site == "route-pre-reply":
+            # the first attempt completed on B before the router
+            # died: the retry was answered from B's journal record
+            assert dedup1 >= dedup0 + 1
+        with open(log2) as fh:
+            assert "taking over" in fh.read()
+    finally:
+        _stop(proc_r2, r_sock)
+
+
+@pytest.mark.slow
+def test_router_end_to_end_golden(serve_tmp, dataset, golden,
+                                  backend_b):
+    """Unix + TCP + wrapper-through-router all return the one-shot
+    CLI bytes; route_status/health/metrics/status render the router
+    state."""
+    proc_a, a_sock, _ = _start_server(serve_tmp, "e2e-a")
+    proc_r, r_sock, _ = _start_router(serve_tmp, "e2e-r",
+                                      [a_sock, backend_b],
+                                      args=("--tcp", "127.0.0.1:0"))
+    try:
+        resp = client.submit(r_sock, _spec(dataset),
+                             job_key="e2e-unix")
+        assert resp["ok"], resp
+        assert resp["routed_backend"] in (a_sock, backend_b)
+        assert base64.b64decode(resp["fasta_b64"]) == golden
+
+        doc = client.route_status(r_sock)
+        assert doc["router"] and doc["ok"]
+        assert {row["target"] for row in doc["backends"]} == \
+            {a_sock, backend_b}
+        assert all(not row["stale"] for row in doc["backends"])
+        assert doc["counters"].get("route_submit", 0) >= 1
+        tcp = doc["tcp"]
+        assert tcp and client.is_tcp_address(tcp)
+
+        # TCP parity: same router, same frames, same bytes
+        tdoc = client.route_status(tcp)
+        assert tdoc["pid"] == doc["pid"]
+        resp_tcp = client.submit(tcp, _spec(dataset),
+                                 job_key="e2e-tcp")
+        assert resp_tcp["ok"]
+        assert base64.b64decode(resp_tcp["fasta_b64"]) == golden
+
+        h = client.health(r_sock)
+        assert h["router"] and h["backends"] == 2
+        assert h["backends_up"] >= 1
+        m = client.metrics(tcp)
+        assert m["router"] and m["route"]["tcp"] == tcp
+        assert "prometheus" in m and "snapshot" in m
+
+        # `racon-tpu status` renders the router document
+        run = subprocess.run(
+            [sys.executable, "-m", "racon_tpu.cli", "status",
+             "--socket", r_sock],
+            cwd=REPO_ROOT, capture_output=True,
+            env=_serve_env(serve_tmp), timeout=120)
+        assert run.returncode == 0, run.stderr.decode()
+        assert b"router" in run.stdout
+        assert a_sock.encode() in run.stdout
+        assert backend_b.encode() in run.stdout
+
+        # the wrapper takes the router's TCP address as --server
+        reads, paf, draft = dataset
+        wdir = os.path.join(serve_tmp, "wrap-router")
+        os.makedirs(wdir, exist_ok=True)
+        wenv = _serve_env(serve_tmp)
+        wenv["PYTHONPATH"] = REPO_ROOT + os.pathsep + \
+            wenv.get("PYTHONPATH", "")
+        run = subprocess.run(
+            [sys.executable, "-m", "racon_tpu.tools.wrapper",
+             "--server", tcp, "-m", "3", "-x", "-5", "-g", "-4",
+             "-t", "4", "-c", "1", "--tpualigner-batches", "1",
+             reads, paf, draft],
+            cwd=wdir, capture_output=True, env=wenv, timeout=600)
+        assert run.returncode == 0, run.stderr.decode()
+        assert run.stdout == golden
+    finally:
+        _stop(proc_a, a_sock)
+        _stop(proc_r, r_sock)
+
+
+@pytest.mark.slow
+def test_router_breaker_opens_and_recovers_live(serve_tmp, dataset,
+                                                golden, backend_b):
+    """A dead backend's breaker OPENs on probe evidence, placements
+    avoid it, and a daemon arriving at that address closes it through
+    the half-open probe."""
+    dead = os.path.join(serve_tmp, "late-a.sock")   # nothing there
+    proc_r, r_sock, _ = _start_router(
+        serve_tmp, "breaker-r", [dead, backend_b],
+        extra_env={"RACON_TPU_ROUTE_PROBE_S": "0.1",
+                   "RACON_TPU_ROUTE_BREAKER_FAILS": "2",
+                   "RACON_TPU_ROUTE_BREAKER_COOLDOWN_S": "0.5"})
+    proc_a = None
+
+    def a_row():
+        doc = client.route_status(r_sock)
+        return ([r for r in doc["backends"]
+                 if r["target"] == dead][0], doc)
+
+    try:
+        deadline = time.monotonic() + 30
+        opened = False
+        while time.monotonic() < deadline:
+            row, doc = a_row()
+            if row["breaker"] == "OPEN":
+                opened = True
+                break
+            time.sleep(0.2)
+        assert opened, doc
+        assert doc["counters"].get(f"route_breaker_open.{dead}",
+                                   0) >= 1
+
+        # placement skips the OPEN backend entirely
+        resp = client.submit(r_sock, _spec(dataset),
+                             job_key="breaker-1")
+        assert resp["ok"] and resp["routed_backend"] == backend_b
+        assert base64.b64decode(resp["fasta_b64"]) == golden
+
+        # the backend comes up at the dead address: a half-open
+        # probe closes the breaker
+        proc_a, a_sock, _ = _start_server(serve_tmp, "late-a")
+        assert a_sock == dead
+        deadline = time.monotonic() + 60
+        closed = False
+        while time.monotonic() < deadline:
+            row, doc = a_row()
+            if row["breaker"] == "CLOSED" and not row["stale"]:
+                closed = True
+                break
+            time.sleep(0.2)
+        assert closed, doc
+
+        states = {(e.get("backend"), e.get("state"))
+                  for e in client.flight(r_sock)["events"]
+                  if e["kind"] == "route_breaker"}
+        assert (dead, "open") in states and (dead, "closed") in states
+    finally:
+        if proc_a is not None:
+            _stop(proc_a, dead)
+        _stop(proc_r, r_sock)
+
+
+@pytest.mark.slow
+def test_router_drain_aware_failover(serve_tmp, dataset, golden,
+                                     backend_b):
+    """SIGTERM (drain) on the placed backend: its in-flight job
+    finishes undisturbed, new placements go elsewhere."""
+    proc_a, a_sock, _ = _start_server(serve_tmp, "drain-a")
+    proc_r, r_sock, _ = _start_router(
+        serve_tmp, "drain-r", [a_sock, backend_b],
+        extra_env={"RACON_TPU_ROUTE_PROBE_S": "0.1"})
+    held = {}
+
+    def first_job():
+        try:
+            held["resp"] = client.submit(r_sock, _spec(dataset),
+                                         job_key="drain-1")
+        except client.ServeError as exc:
+            held["err"] = exc
+
+    t = threading.Thread(target=first_job)
+    t.start()
+    try:
+        # wait until the job is RUNNING on A (tie-break placed it
+        # there), then drain A
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if client.health(a_sock).get("running", 0) >= 1:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("job never started on backend A")
+        proc_a.send_signal(signal.SIGTERM)
+
+        # a new job must not land on the draining backend
+        resp2 = client.submit_with_retry(r_sock, _spec(dataset),
+                                         retries=4, job_key="drain-2")
+        assert resp2["ok"], resp2
+        assert resp2["routed_backend"] == backend_b, resp2
+        assert base64.b64decode(resp2["fasta_b64"]) == golden
+
+        t.join(timeout=300)
+        assert not t.is_alive()
+        assert "resp" in held, held.get("err")
+        assert held["resp"]["ok"], held["resp"]
+        assert held["resp"]["routed_backend"] == a_sock
+        assert base64.b64decode(held["resp"]["fasta_b64"]) == golden
+        assert proc_a.wait(timeout=120) == 0     # clean drained exit
+    finally:
+        if proc_a.poll() is None:
+            proc_a.kill()
+        _stop(proc_r, r_sock)
+
+
+@pytest.mark.slow
+def test_router_job_too_large_spillover(serve_tmp, dataset, golden,
+                                        backend_b):
+    """An admission-control reject (job_too_large) spills to the
+    next-best backend instead of surfacing."""
+    proc_a, a_sock, _ = _start_server(
+        serve_tmp, "small-a",
+        extra_env={"RACON_TPU_SERVE_MAX_WALL_S": "0.000001"})
+    proc_r, r_sock, _ = _start_router(serve_tmp, "small-r",
+                                      [a_sock, backend_b])
+    try:
+        resp = client.submit(r_sock, _spec(dataset),
+                             job_key="spill-1")
+        assert resp["ok"], resp
+        assert resp["routed_backend"] == backend_b
+        assert base64.b64decode(resp["fasta_b64"]) == golden
+        doc = client.route_status(r_sock)
+        assert doc["counters"].get("route_spillover", 0) >= 1
+        spills = [e for e in client.flight(r_sock)["events"]
+                  if e["kind"] == "route_spillover"]
+        assert any(e.get("code") == "job_too_large" for e in spills)
+    finally:
+        _stop(proc_a, a_sock)
+        _stop(proc_r, r_sock)
+
+
+@pytest.mark.slow
+def test_wrapper_degraded_daemon_list_failover(serve_tmp, dataset,
+                                               golden, backend_b):
+    """--server with a comma-separated daemon list (no router):
+    client-side round-robin walks past the dead daemon and the run
+    still matches the one-shot CLI bytes."""
+    dead = os.path.join(serve_tmp, "gone.sock")
+    reads, paf, draft = dataset
+    wdir = os.path.join(serve_tmp, "wrap-list")
+    os.makedirs(wdir, exist_ok=True)
+    env = _serve_env(serve_tmp)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.tools.wrapper",
+         "--server", f"{dead},{backend_b}",
+         "-m", "3", "-x", "-5", "-g", "-4",
+         "-t", "4", "-c", "1", "--tpualigner-batches", "1",
+         reads, paf, draft],
+        cwd=wdir, capture_output=True, env=env, timeout=600)
+    assert run.returncode == 0, run.stderr.decode()
+    assert run.stdout == golden
+    assert b"unreachable" in run.stderr
