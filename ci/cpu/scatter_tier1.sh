@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Scatter/gather chaos lane (ISSUE 16 CI satellite): runs the scatter
+# suite — shard planning / derived-key units, the target-shard byte
+# contract (full run == 3-shard concat), in-process router scatter
+# over stub backends, cache-affinity tiebreak, and the acceptance
+# pin: with the router scattering one job across three daemons,
+# SIGKILL of the backend running a shard at EVERY r17 fault site is
+# invisible to the client (merged FASTA byte-identical to the
+# one-shot CLI via per-shard failover under the derived
+# <key>-shard-<i>of<k> keys, exactly-once per shard through the survivor
+# journals), and SIGKILL of the ROUTER mid-gather stays exactly-once
+# on retry (every shard answered from a backend journal record).
+# The multi-daemon tests are @pytest.mark.slow — the tier-1 sweep
+# (-m 'not slow') keeps only the fast in-process/unit tests, so this
+# lane (no marker filter) is where the shard kill matrix runs.
+# Scatter is forced on by the tests themselves (explicit shards=3 on
+# each submit — deterministic shard counts, no threshold guessing).
+# Hardening mirrors the router lane:
+#   * JAX_PLATFORMS=cpu + 8 virtual devices (tests/conftest.py)
+#     exercises the sharded dispatch path without hardware;
+#   * the journal is pinned ON — exactly-once-per-shard is a journal
+#     property, so a stray RACON_TPU_JOURNAL=0 must not silently
+#     downgrade the chaos pins to at-least-once;
+#   * PYTHONDEVMODE=1 surfaces unclosed shard/fan-out sockets across
+#     the kill/failover cycles;
+#   * pytest's faulthandler timeout dumps every thread's traceback
+#     if a gather hangs — a shard stuck mid-round shows up as a
+#     stack dump naming the blocked wait, not an opaque timeout.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export PYTHONDEVMODE=1
+export RACON_TPU_JOURNAL=1
+unset RACON_TPU_FAULT || true
+python -m pytest tests/test_scatter.py -q \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"

@@ -67,6 +67,12 @@ EPOCH_EXCLUDE = frozenset({
     "RACON_TPU_ROUTE_BREAKER_FAILS",
     "RACON_TPU_ROUTE_BREAKER_COOLDOWN_S",
     "RACON_TPU_ROUTE_TCP",
+    # scatter/gather (r20): shard count is placement policy, never a
+    # bytes decision — the shard mask only changes WHICH targets a
+    # process emits, and concatenation in shard order is pinned
+    # byte-identical to the unsharded run (target_slice contract)
+    "RACON_TPU_SCATTER_MIN_WALL_S",
+    "RACON_TPU_SCATTER_MAX_SHARDS",
 })
 
 DIGEST_SIZE = 32

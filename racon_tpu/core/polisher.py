@@ -93,6 +93,10 @@ class Polisher:
         self.windows: List[Window] = []
         self.targets_coverages: List[int] = []
         self._owned_targets = None   # multi-host target mask
+        # r20 scatter: the serve tier sets (index, count) on a
+        # target-sharded sub-job; initialize() turns it into the same
+        # target_slice ownership mask the multi-host path uses
+        self._target_shard = None
         # streaming bookkeeping (racon_tpu/tpu/polisher.py pipeline):
         # window-id offsets per target, and whether the subclass
         # already counted per-target coverages at registration time
@@ -149,6 +153,21 @@ class Polisher:
             self.logger.log(
                 f"[racon_tpu::Polisher::initialize] multi-host rank "
                 f"{rank}/{nproc}: targets [{sl.start}, {sl.stop})")
+        # r20 scatter (racon_tpu/serve/scatter.py): a scattered
+        # sub-job owns one target_slice shard of the full target set.
+        # Reusing the multi-host mask means the shard's emitted bytes
+        # are exactly the slice the `cat part*.fa` contract pins, so
+        # concatenating shard outputs in index order reproduces the
+        # unsharded run byte-for-byte.  A multi-host rank is never
+        # also a serve shard (the mask above wins).
+        if self._owned_targets is None and self._target_shard:
+            index, count = self._target_shard
+            sl = multihost.target_slice(targets_size, count, index)
+            self._owned_targets = [sl.start <= i < sl.stop
+                                   for i in range(targets_size)]
+            self.logger.log(
+                f"[racon_tpu::Polisher::initialize] target shard "
+                f"{index}/{count}: targets [{sl.start}, {sl.stop})")
 
         name_to_id: Dict[str, int] = {}
         id_to_id: Dict[int, int] = {}

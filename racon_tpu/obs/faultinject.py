@@ -24,6 +24,10 @@ killing a backend under the router):
 
 * ``route-pre-forward`` — placement chosen, job not yet forwarded
 * ``route-pre-reply``   — backend answered, reply not yet sent
+* ``route-mid-gather``  — every shard of a scattered job done and
+  journaled on its backend, merged reply not yet assembled (r20);
+  a restarted router re-plans the same shards and the backend
+  journals answer every one as a duplicate
 
 Counting is per-process and lock-guarded, so ``<site>:<nth>`` is
 deterministic under concurrent workers.  An unarmed site costs one
@@ -40,7 +44,7 @@ import threading
 
 SITES = ("post-admit", "mid-megabatch", "pre-demux",
          "pre-done-record", "journal-write",
-         "route-pre-forward", "route-pre-reply")
+         "route-pre-forward", "route-pre-reply", "route-mid-gather")
 
 _lock = threading.Lock()
 _counts: dict = {}

@@ -124,6 +124,14 @@ KNOWN_KNOBS = {
     "RACON_TPU_CACHE": "1",
     "RACON_TPU_CACHE_MB": "256",
     "RACON_TPU_CACHE_PERSIST": "",
+    # scatter/gather mega-job sharding (r20, racon_tpu/serve/
+    # scatter.py): auto-scatter threshold on the predicted wall
+    # ("" = scatter only on an explicit --shards) and the shard-count
+    # cap.  Shard count is placement policy — a shard's bytes are
+    # the target_slice contract's, so cache/keying.py EXCLUDES both
+    # from the engine epoch.
+    "RACON_TPU_SCATTER_MIN_WALL_S": "",
+    "RACON_TPU_SCATTER_MAX_SHARDS": "8",
 }
 
 # host-capability probe reference wall (bench.py's budget scaling):

@@ -96,7 +96,8 @@ def request(socket_path: str, frame: dict, timeout: float = None):
 
 def submit(socket_path: str, spec: dict, priority: int = 0,
            timeout: float = None, want_trace: bool = False,
-           trace_context: str = None, job_key: str = None) -> dict:
+           trace_context: str = None, job_key: str = None,
+           shards=None) -> dict:
     """Submit one job and block until it completes (or is rejected).
     Returns the raw response frame; callers check ``resp["ok"]``.
     ``want_trace`` asks the server to attach the job's trace slice
@@ -108,7 +109,12 @@ def submit(socket_path: str, spec: dict, priority: int = 0,
     ``job_key`` (same charset) is the idempotence key (r17): a
     duplicate submit with the same key joins the live job or is
     answered from the daemon's write-ahead journal record — the job
-    runs exactly once, across client retries AND daemon restarts."""
+    runs exactly once, across client retries AND daemon restarts.
+    ``shards`` (r20, router targets only) asks for scatter/gather
+    sharding: an int forces that many target shards, ``"auto"`` lets
+    the router split across its eligible backends, 0 forces an
+    unsharded run; a plain daemon rejects the field's effects by
+    simply never seeing it (the router consumes it)."""
     frame = {"op": "submit", "job": spec, "priority": priority}
     if want_trace:
         frame["trace"] = True
@@ -116,6 +122,8 @@ def submit(socket_path: str, spec: dict, priority: int = 0,
         frame["trace_context"] = trace_context
     if job_key is not None:
         frame["job_key"] = job_key
+    if shards is not None:
+        frame["shards"] = shards
     return request(socket_path, frame, timeout=timeout)
 
 
@@ -123,7 +131,7 @@ def submit_with_retry(socket_path: str, spec: dict,
                       priority: int = 0, retries: int = 0,
                       timeout: float = None, want_trace: bool = False,
                       trace_context: str = None,
-                      job_key: str = None) -> dict:
+                      job_key: str = None, shards=None) -> dict:
     """:func:`submit`, retried with jittered exponential backoff
     (~0.5 s base, doubling, capped at 30 s; jitter 0.5x..1.5x so a
     herd of clients doesn't re-land in lockstep).
@@ -154,7 +162,7 @@ def submit_with_retry(socket_path: str, spec: dict,
             resp = submit(socket_path, spec, priority=priority,
                           timeout=timeout, want_trace=want_trace,
                           trace_context=trace_context,
-                          job_key=job_key)
+                          job_key=job_key, shards=shards)
         except ServeError as exc:
             if attempt >= retries:
                 raise
@@ -293,10 +301,10 @@ def spec_from_opts(opts: dict, inputs, tenant: str = None) -> dict:
 
 def _split_serve_flags(argv):
     """Pull --socket/--priority/--tenant/--trace-context/--job-key/
-    --retry out of the argv so the rest parses with the unchanged
-    one-shot ``cli.parse_args``."""
+    --retry/--shards out of the argv so the rest parses with the
+    unchanged one-shot ``cli.parse_args``."""
     socket_path, priority, tenant, trace_context = None, 0, None, None
-    job_key, retry = None, 0
+    job_key, retry, shards = None, 0, None
     rest = []
     i = 0
     while i < len(argv):
@@ -331,18 +339,25 @@ def _split_serve_flags(argv):
             retry = int(argv[i]) if i < len(argv) else 0
         elif a.startswith("--retry="):
             retry = int(a.split("=", 1)[1])
+        elif a == "--shards":
+            i += 1
+            shards = argv[i] if i < len(argv) else None
+        elif a.startswith("--shards="):
+            shards = a.split("=", 1)[1]
         else:
             rest.append(a)
         i += 1
+    if shards is not None and shards != "auto":
+        shards = int(shards)
     return (socket_path, priority, tenant, trace_context, job_key,
-            retry, rest)
+            retry, shards, rest)
 
 
 def main_submit(argv) -> int:
     from racon_tpu import cli
 
     socket_path, priority, tenant, trace_context, job_key, retry, \
-        rest = _split_serve_flags(argv)
+        shards, rest = _split_serve_flags(argv)
     if not socket_path:
         print("[racon_tpu::submit] error: --socket PATH is required!",
               file=sys.stderr)
@@ -357,7 +372,8 @@ def main_submit(argv) -> int:
             socket_path, spec_from_opts(opts, inputs, tenant=tenant),
             priority=priority, retries=max(0, retry),
             want_trace=bool(opts["trace"]),
-            trace_context=trace_context, job_key=job_key)
+            trace_context=trace_context, job_key=job_key,
+            shards=shards)
     except ServeError as exc:
         print(f"[racon_tpu::submit] error: {exc}", file=sys.stderr)
         return 1
@@ -423,6 +439,15 @@ def _print_router_status(doc: dict) -> int:
           f"{c.get('route_spillover', 0)} spillover(s), "
           f"{c.get('route_failover', 0)} failover(s), "
           f"{c.get('route_dedup_joins', 0)} dedup join(s)")
+    sc = doc.get("scatter") or {}
+    if c.get("route_scatter_jobs") or sc.get("active"):
+        print(f"scatter     {c.get('route_scatter_jobs', 0)} "
+              f"job(s) -> {c.get('route_scatter_shards', 0)} "
+              f"shard(s), {c.get('route_cache_affinity', 0)} "
+              f"affinity pick(s)")
+    for row in sc.get("active") or []:
+        print(f"scatter     {row.get('job_key')}: "
+              f"{row.get('done')}/{row.get('shards')} shard(s) done")
     backends = doc.get("backends") or []
     if backends:
         print("backend                           breaker    fails  "
@@ -444,7 +469,7 @@ def _print_router_status(doc: dict) -> int:
 
 
 def main_status(argv) -> int:
-    socket_path, _, _, _, _, _, rest = _split_serve_flags(argv)
+    socket_path, _, _, _, _, _, _, rest = _split_serve_flags(argv)
     as_json = "--json" in rest
     rest = [a for a in rest if a != "--json"]
     if not socket_path or rest:

@@ -163,6 +163,29 @@ Fleet routing (r19, racon_tpu/serve/router.py):
   prefer the hint over their blind exponential schedules; the
   jittered schedule remains the fallback.  A router that exhausts
   every backend answers the code ``no_backend``.
+
+Scatter/gather mega-job sharding (r20, racon_tpu/serve/scatter.py):
+
+* ``submit`` takes an optional ``shards`` field — an int (forced
+  shard count; 0 forces unsharded), or ``"auto"`` (one shard per
+  eligible backend).  Routers consume it; absent the field, a router
+  auto-scatters only when the admission estimate exceeds
+  ``RACON_TPU_SCATTER_MIN_WALL_S``.  Plain daemons instead accept a
+  sub-job field ``spec["shard"] = [index, count]`` — the target
+  shard the polisher owns (the ``target_slice`` contract) — which
+  the router sets on each fanned-out sub-job; sub-jobs run under
+  derived idempotence keys ``<job_key>-shard-<i>of<k>`` so the r17
+  journal gives exactly-once per shard.
+* A scattered submit's response is ONE merged frame: the FASTA is
+  the shard outputs concatenated in shard order (byte-identical to
+  the unsharded run by construction), ``report`` is a
+  ``racon-tpu-scatter-v1`` doc with ``per_shard`` sub-blocks and
+  the full shard reports, and a ``scatter`` block names the shard
+  count and backends.  ``route_status`` shows live scatter progress
+  (``scatter.active``: per-job done/shards counts) plus the
+  ``route_scatter_jobs``/``route_scatter_shards``/
+  ``route_cache_affinity`` counters; a router's ``health`` doc
+  carries ``scatter: true`` as the capability flag wrappers key off.
 """
 
 from __future__ import annotations

@@ -45,6 +45,26 @@ fault-tolerance layer of the ROADMAP's fleet-scale serving item.
   client (pinned by tests/test_router.py's chaos matrix).  Completed
   keys stay sticky: a duplicate keyed submit routes to the backend
   that ran it, whose journal answers from the record.
+* **Scatter/gather mega-job sharding (r20)** — a submit whose
+  admission estimate exceeds ``RACON_TPU_SCATTER_MIN_WALL_S`` (or
+  that carries an explicit ``shards`` field) is split into K
+  target-sharded sub-jobs (racon_tpu/serve/scatter.py) fanned out
+  concurrently, each placed independently (cheapest predicted shared
+  wall, honoring breakers/draining) under the derived key
+  ``<job_key>-shard-<i>of<k>`` — so the r17 journal + the crash failover
+  below give exactly-once per SHARD: a backend death mid-shard
+  re-places only that shard.  The gather concatenates the shard
+  FASTAs in shard order — byte-identical to the unsharded run by the
+  ``target_slice`` contract — and answers the client with one merged
+  frame whose report carries per-shard sub-blocks.  Shard progress is
+  visible in ``route_status`` while a scatter is live.
+* **Cache-affinity tiebreak** — when predicted walls tie within 10%,
+  placement prefers the backend whose result cache (r14/r18) reports
+  the higher hit ratio — and, among those, one that recently served
+  this tenant's content-keyed jobs — recorded as a
+  ``route_cache_affinity`` flight event.  Affinity only ever picks
+  among near-equal predictions: it can turn a warm cache into a
+  fleet-wide property, never override the cost model.
 * **TCP front** — ``--tcp HOST:PORT`` (or ``RACON_TPU_ROUTE_TCP``)
   additionally listens on TCP with the SAME length-prefixed JSON
   framing (racon_tpu/serve/protocol.py works on any socket object),
@@ -69,11 +89,15 @@ Knobs (all placement policy — none can change job bytes, so all are
 * ``RACON_TPU_ROUTE_BREAKER_FAILS``      failures to OPEN (3)
 * ``RACON_TPU_ROUTE_BREAKER_COOLDOWN_S`` OPEN -> half-open (5.0)
 * ``RACON_TPU_ROUTE_TCP``                TCP bind, "" = off
+* ``RACON_TPU_SCATTER_MIN_WALL_S``       auto-scatter threshold,
+  "" = only explicit ``--shards`` scatters
+* ``RACON_TPU_SCATTER_MAX_SHARDS``       shard-count cap (8)
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
 import itertools
 import os
 import random
@@ -87,7 +111,7 @@ from racon_tpu.obs import context as obs_context
 from racon_tpu.obs import faultinject
 from racon_tpu.obs import flight as obs_flight
 from racon_tpu.obs import trace as obs_trace
-from racon_tpu.serve import client, protocol
+from racon_tpu.serve import client, protocol, scatter
 
 
 def eprint(*args):
@@ -285,6 +309,16 @@ class FleetRouter:
         self._in_flight = 0          # live proxied submits
         self._live: dict = {}        # job_key -> _RoutedJob
         self._done_backend: dict = {}  # job_key -> backend target
+        # r20 scatter state: placements this router has chosen but
+        # whose submits are still in flight (so K concurrent shards
+        # spread instead of all picking the same stale-cheapest
+        # backend), live shard-progress docs for route_status, and a
+        # bounded per-tenant memory of which backends recently served
+        # content-keyed jobs (the cache-affinity tiebreak)
+        self._plan_lock = threading.Lock()
+        self._placing: dict = {}       # backend target -> in-flight
+        self._scatter_live: dict = {}  # job_key -> progress doc
+        self._tenant_recent: dict = {}  # tenant -> deque of targets
         self._keyseq = itertools.count(1)
         self._t_start = obs_trace.now()
         self._drain_logged = False
@@ -367,15 +401,96 @@ class FleetRouter:
         except (OSError, KeyError, TypeError, ValueError):
             return None
 
-    def _rank(self, spec: dict, exclude=()) -> list:
+    def _placing_inc(self, target: str) -> None:
+        with self._lock:
+            self._placing[target] = self._placing.get(target, 0) + 1
+
+    def _placing_dec(self, target: str) -> None:
+        with self._lock:
+            n = self._placing.get(target, 0) - 1
+            if n > 0:
+                self._placing[target] = n
+            else:
+                self._placing.pop(target, None)
+
+    @staticmethod
+    def _hit_ratio(backend: Backend) -> float:
+        """The backend's result-cache hit ratio from its last good
+        health doc (0.0 when it reports no cache block)."""
+        cache = ((backend.health or {}).get("cache") or {})
+        try:
+            return float(cache.get("hit_ratio") or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    def _affinity_reorder(self, rows: list, tenant: str) -> list:
+        """Cache-locality tiebreak: among backends whose predicted
+        wall is within 10% of the best, prefer the hottest result
+        cache, then one that recently served this tenant's
+        content-keyed jobs.  First-max on ties keeps placement
+        deterministic; unpriceable specs (wall == inf) never
+        reorder — affinity refines the cost model, it never replaces
+        it.  Rows are the pre-sorted ``(wall, load, idx, backend,
+        est)`` tuples."""
+        if len(rows) < 2:
+            return rows
+        best_wall = rows[0][0]
+        if not best_wall < float("inf"):
+            return rows
+        tied = [r for r in rows if r[0] <= best_wall * 1.10]
+        if len(tied) < 2:
+            return rows
+        with self._lock:
+            recent = set(self._tenant_recent.get(tenant or "default",
+                                                 ()))
+
+        def warmth(row):
+            return (round(self._hit_ratio(row[3]), 3),
+                    1 if row[3].target in recent else 0)
+
+        leader = max(tied, key=warmth)
+        if leader is rows[0] or warmth(leader) <= warmth(rows[0]):
+            return rows
+        REGISTRY.add("route_cache_affinity")
+        obs_flight.FLIGHT.record(
+            "route_cache_affinity", backend=leader[3].target,
+            over=rows[0][3].target, tenant=tenant,
+            hit_ratio=self._hit_ratio(leader[3]),
+            wall_s=(round(leader[0], 4)
+                    if leader[0] < float("inf") else None))
+        rows.remove(leader)
+        rows.insert(0, leader)
+        return rows
+
+    def _note_tenant_backend(self, tenant: str, job_key: str,
+                             target: str) -> None:
+        """Remember which backend served a tenant's CONTENT-keyed job
+        (router-minted ``route-*`` keys carry no content identity, so
+        nothing would be warm for their duplicates)."""
+        if not job_key or job_key.startswith("route-"):
+            return
+        with self._lock:
+            dq = self._tenant_recent.get(tenant or "default")
+            if dq is None:
+                dq = collections.deque(maxlen=32)
+                self._tenant_recent[tenant or "default"] = dq
+            dq.append(target)
+
+    def _rank(self, spec: dict, exclude=(), tenant: str = None) -> list:
         """Eligible backends, best placement first: (predicted wall,
         load, CLI list order) — the last term makes placement
-        deterministic under equal load."""
+        deterministic under equal load.  Load counts this router's
+        own still-in-flight placements on top of the probed depth, so
+        K scattered shards planned in one burst spread over the fleet
+        instead of all chasing the same stale-cheapest backend.  Near
+        ties then yield to cache affinity (:meth:`_affinity_reorder`)."""
         rows = []
+        with self._lock:
+            placing = dict(self._placing)
         for idx, backend in enumerate(self.backends):
             if backend.target in exclude or not backend.eligible():
                 continue
-            load = backend.load()
+            load = backend.load() + placing.get(backend.target, 0)
             est = self._price(spec, load + 1)
             wall = None
             if est:
@@ -384,6 +499,7 @@ class FleetRouter:
             rows.append((wall if wall is not None else float("inf"),
                          load, idx, backend, est))
         rows.sort(key=lambda r: (r[0], r[1], r[2]))
+        rows = self._affinity_reorder(rows, tenant)
         return [(backend, est) for _, _, _, backend, est in rows]
 
     # -- submit proxying -----------------------------------------------
@@ -400,6 +516,11 @@ class FleetRouter:
                 "bad_request",
                 "job_key must be 1..128 chars of "
                 "[A-Za-z0-9._:-] starting alphanumeric")
+        try:
+            requested_shards = scatter.parse_requested(
+                req.get("shards"))
+        except ValueError as exc:
+            return protocol.error_frame("bad_request", str(exc))
         if self._stop.is_set():
             REGISTRY.add("route_reject.draining")
             return protocol.error_frame(
@@ -428,7 +549,8 @@ class FleetRouter:
             live.done.wait()
             return live.response
         try:
-            resp = self._route_job(spec, req, job_key)
+            resp = self._submit_planned(spec, req, job_key,
+                                        requested_shards)
         except Exception as exc:     # router bug: job fails, router
             obs_flight.FLIGHT.record_exception(   # survives
                 "route_error", exc)
@@ -447,55 +569,199 @@ class FleetRouter:
         live.done.set()
         return resp
 
-    def _route_job(self, spec: dict, req: dict,
-                   job_key: str) -> dict:
+    def _submit_planned(self, spec: dict, req: dict, job_key: str,
+                        requested) -> dict:
+        """Decide scatter vs unsharded for a submit this router owns,
+        then run it.  Auto-scatter prices the whole job once at
+        concurrency 1 (the single-backend wall the split is trying to
+        beat) and only engages when RACON_TPU_SCATTER_MIN_WALL_S is
+        set; an explicit ``shards`` on the submit always wins."""
+        n_eligible = sum(1 for b in self.backends if b.eligible())
+        wall = None
+        if requested is None and scatter.min_wall_s() is not None:
+            est = self._price(spec, 1)
+            if est:
+                wall = est.get("predicted_wall_s")
+        k = scatter.plan_shards(requested, wall, n_eligible)
+        if k <= 1:
+            return self._route_job(spec, req, job_key)
+        return self._scatter_job(spec, req, job_key, k)
+
+    def _scatter_job(self, spec: dict, req: dict, job_key: str,
+                     k: int) -> dict:
+        """Fan a mega-job out as K target-sharded sub-jobs and gather
+        the merged reply.  Each shard is a full :meth:`_route_job` —
+        independently priced, spilled over, failed over — under the
+        derived key ``<job_key>-shard-<i>of<k>``, so exactly-once
+        per shard rides on the r17 backend journals: a duplicate of
+        the WHOLE mega-job (e.g. a client retry through a restarted
+        router) re-plans identical shards and every backend answers
+        its shard from the record.  An explicit shard count is never
+        capped by transient eligibility (scatter.plan_shards), so the
+        retry's plan matches the original's even when a breaker
+        opened in between; and because ``k`` is baked into the key, a
+        retry whose auto/threshold plan DID change simply misses the
+        old records and re-runs fresh instead of gathering stale
+        slices.
+
+        For that journal rendezvous to actually happen, the duplicate
+        must re-MEET its records: shard i's first-choice backend is
+        the i-th eligible backend in CLI list order — a deterministic
+        mapping that survives router restarts (same ``--backends``
+        flag => same mapping) and spreads K shards over the fleet by
+        construction.  It is only a preference: cost ranking takes
+        over the moment the preferred backend is dead, draining or
+        full, and a re-run on a different survivor still returns the
+        same bytes (the target_slice contract) — exactly-once decays
+        to at-least-once only when the fleet itself changed between
+        duplicates."""
+        t0 = obs_trace.now()
+        REGISTRY.add("route_scatter_jobs")
+        REGISTRY.add("route_scatter_shards", k)
+        keys = [scatter.shard_key(job_key, i, k) for i in range(k)]
+        eligible = [b.target for b in self.backends if b.eligible()]
+        prefer = {i: eligible[i % len(eligible)]
+                  for i in range(k)} if eligible else {}
+        progress = {"job_key": job_key, "shards": k, "done": 0,
+                    "backends": [None] * k}
+        with self._lock:
+            self._scatter_live[job_key] = progress
+        obs_flight.FLIGHT.record(
+            "route_scatter", job_key=job_key, shards=k,
+            tenant=spec.get("tenant"))
+        eprint(f"[racon_tpu::route] scatter: job {job_key} -> {k} "
+               f"target shard(s)")
+        results = [None] * k
+
+        def run_shard(i: int) -> None:
+            resp = self._route_job(scatter.shard_spec(spec, i, k),
+                                   req, keys[i],
+                                   prefer=prefer.get(i))
+            results[i] = resp
+            with self._lock:
+                progress["done"] += 1
+                progress["backends"][i] = resp.get("routed_backend")
+                if resp.get("ok") and resp.get("routed_backend"):
+                    # per-shard sticky: a later duplicate of this
+                    # mega-job routes each shard straight back to
+                    # the journal that recorded it, even if failover
+                    # moved the shard off its preferred backend
+                    self._done_backend[keys[i]] = \
+                        resp["routed_backend"]
+            obs_flight.FLIGHT.record(
+                "route_scatter_shard", job_key=job_key, shard=i,
+                ok=bool(resp.get("ok")),
+                backend=resp.get("routed_backend"),
+                wall_s=resp.get("wall_s"))
+
+        threads = [threading.Thread(target=run_shard, args=(i,),
+                                    daemon=True,
+                                    name=f"racon-route-shard-{i}")
+                   for i in range(k)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            faultinject.hit("route-mid-gather")
+            for i, resp in enumerate(results):
+                if resp is not None and resp.get("ok"):
+                    continue
+                # surface the first failed shard; completed siblings
+                # are journaled on their backends, so the client's
+                # retry under the same key re-runs ONLY the failures
+                REGISTRY.add("route_scatter_failed")
+                err = dict((resp or {}).get("error")
+                           or {"code": "job_failed",
+                               "reason": "shard returned no "
+                                         "response"})
+                err["shard"] = i
+                err["shards"] = k
+                return {"ok": False, "error": err}
+            out = scatter.merge_responses(results, keys)
+            wall = obs_trace.now() - t0
+            out["wall_s"] = round(wall, 6)
+            out["scatter"] = {"shards": k,
+                              "backends": list(progress["backends"])}
+            obs_flight.FLIGHT.record(
+                "route_gather", job_key=job_key, shards=k,
+                wall_s=round(wall, 6),
+                n_sequences=out.get("n_sequences"))
+            return out
+        finally:
+            with self._lock:
+                self._scatter_live.pop(job_key, None)
+
+    def _route_job(self, spec: dict, req: dict, job_key: str,
+                   prefer: str = None) -> dict:
         priority = int(req.get("priority", 0))
+        tenant = spec.get("tenant") if isinstance(spec, dict) else None
         dead = set()          # backends that transport-failed: never
         last_reject = None    # retried for THIS job this round-trip
-        sticky = self._done_backend.get(job_key)
+        # a recorded completion outranks the scatter plan's
+        # deterministic shard preference; both are soft — cost order
+        # resumes for everything behind the front of the list
+        sticky = self._done_backend.get(job_key) or prefer
         for round_no in range(_MAX_ROUNDS):
             hint = None
-            ranked = self._rank(spec, exclude=dead)
-            if sticky is not None:
-                # a completed key's duplicate goes back to the
-                # recording backend first (stable sort keeps the
-                # cost order for the rest)
-                ranked.sort(key=lambda row:
-                            0 if row[0].target == sticky else 1)
-            for backend, est in ranked:
-                faultinject.hit("route-pre-forward")
-                REGISTRY.add("route_submit")
-                obs_flight.FLIGHT.record(
-                    "route", job_key=job_key, backend=backend.target,
-                    round=round_no, load=backend.load(),
-                    predicted_wall_s=(round(est.get(
-                        "shared_wall_s",
-                        est.get("predicted_wall_s", 0.0)), 4)
-                        if est else None))
+            tried = set()     # retryable rejects this round
+            while True:
+                # pick under the plan lock so concurrent placements
+                # (scattered shards above all) see each other's
+                # still-in-flight choices and spread; the forward
+                # itself runs outside the lock
+                with self._plan_lock:
+                    ranked = self._rank(spec, exclude=dead | tried,
+                                        tenant=tenant)
+                    if sticky is not None:
+                        # a completed key's duplicate goes back to
+                        # the recording backend first (stable sort
+                        # keeps the cost order for the rest)
+                        ranked.sort(key=lambda row:
+                                    0 if row[0].target == sticky
+                                    else 1)
+                    if not ranked:
+                        break
+                    backend, est = ranked[0]
+                    self._placing_inc(backend.target)
                 try:
-                    resp = client.submit(
-                        backend.target, spec, priority=priority,
-                        want_trace=bool(req.get("trace")),
-                        trace_context=req.get("trace_context"),
-                        job_key=job_key)
-                except client.ServeError as exc:
-                    # the backend died (possibly mid-job): crash
-                    # failover — feed the breaker and resubmit the
-                    # SAME key to the next survivor; the r17 journal
-                    # dedup makes the retry exactly-once
-                    if backend.note_failure(str(exc),
-                                            obs_trace.now()):
-                        self._record_breaker_open(backend, str(exc))
-                    REGISTRY.add("route_failover")
+                    faultinject.hit("route-pre-forward")
+                    REGISTRY.add("route_submit")
                     obs_flight.FLIGHT.record(
-                        "route_failover", job_key=job_key,
+                        "route", job_key=job_key,
                         backend=backend.target,
-                        error=str(exc)[:200])
-                    eprint(f"[racon_tpu::route] backend "
-                           f"{backend.target} failed mid-submit "
-                           f"({exc}); failing over")
-                    dead.add(backend.target)
-                    continue
+                        round=round_no, load=backend.load(),
+                        predicted_wall_s=(round(est.get(
+                            "shared_wall_s",
+                            est.get("predicted_wall_s", 0.0)), 4)
+                            if est else None))
+                    try:
+                        resp = client.submit(
+                            backend.target, spec, priority=priority,
+                            want_trace=bool(req.get("trace")),
+                            trace_context=req.get("trace_context"),
+                            job_key=job_key)
+                    except client.ServeError as exc:
+                        # the backend died (possibly mid-job): crash
+                        # failover — feed the breaker and resubmit
+                        # the SAME key to the next survivor; the r17
+                        # journal dedup makes the retry exactly-once
+                        if backend.note_failure(str(exc),
+                                                obs_trace.now()):
+                            self._record_breaker_open(backend,
+                                                      str(exc))
+                        REGISTRY.add("route_failover")
+                        obs_flight.FLIGHT.record(
+                            "route_failover", job_key=job_key,
+                            backend=backend.target,
+                            error=str(exc)[:200])
+                        eprint(f"[racon_tpu::route] backend "
+                               f"{backend.target} failed mid-submit "
+                               f"({exc}); failing over")
+                        dead.add(backend.target)
+                        continue
+                finally:
+                    self._placing_dec(backend.target)
                 err = (resp.get("error") or {}) \
                     if not resp.get("ok") else {}
                 code = err.get("code")
@@ -515,12 +781,16 @@ class FleetRouter:
                     except (KeyError, TypeError, ValueError):
                         pass
                     last_reject = resp
+                    tried.add(backend.target)
                     continue
                 # success, or a reject that is the CLIENT's to see
                 # (bad_request / input_not_found / job_failed —
                 # another backend would answer the same)
                 out = dict(resp)
                 out["routed_backend"] = backend.target
+                if out.get("ok"):
+                    self._note_tenant_backend(tenant, job_key,
+                                              backend.target)
                 return out
             if round_no + 1 < _MAX_ROUNDS and not self._stop.is_set():
                 # every eligible backend rejected retryably: honor
@@ -564,6 +834,10 @@ class FleetRouter:
         with self._lock:
             in_flight = self._in_flight
             done_keys = len(self._done_backend)
+            scatter_rows = [
+                {"job_key": p["job_key"], "shards": p["shards"],
+                 "done": p["done"], "backends": list(p["backends"])}
+                for p in self._scatter_live.values()]
         return {
             "ok": True,
             "router": True,
@@ -577,6 +851,9 @@ class FleetRouter:
             "routed_keys": done_keys,
             "probe_interval_s": self.probe_interval,
             "backends": rows,
+            "scatter": {"active": scatter_rows,
+                        "min_wall_s": scatter.min_wall_s(),
+                        "max_shards": scatter.max_shards()},
             "counters": counters,
         }
 
@@ -587,6 +864,9 @@ class FleetRouter:
         return {
             "ok": True,
             "router": True,
+            # capability flag: a wrapper --server pointed here skips
+            # client-side --split and lets the router scatter instead
+            "scatter": True,
             "status": ("draining" if self._stop.is_set() else "ok"),
             "accepting": not self._stop.is_set(),
             "pid": os.getpid(),
@@ -852,8 +1132,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
         prog="racon-tpu route",
         description="Fault-tolerant router fronting several "
         "racon-tpu serve daemons: health-probed placement, "
-        "spillover on backpressure, circuit breakers, and "
-        "exactly-once crash failover via idempotent job keys.")
+        "spillover on backpressure, circuit breakers, "
+        "exactly-once crash failover via idempotent job keys, and "
+        "scatter/gather sharding of large jobs across the fleet.")
     p.add_argument("--socket", required=True,
                    help="unix-domain socket path to listen on")
     p.add_argument("--backends", required=True,

@@ -342,6 +342,24 @@ class JobScheduler:
                 "code": "bad_request",
                 "reason": "tenant must be a non-empty string "
                           "of at most 64 characters"})
+        # r20 scatter: a routed sub-job carries its target shard as
+        # spec["shard"] = [index, count] (tenant/priority already ride
+        # the spec/frame, so a shard inherits both from the mega-job).
+        # Validate the shape at admission — a malformed shard must be
+        # a bad_request, not a mid-polish job_failed.
+        shard = spec.get("shard")
+        if shard is not None:
+            ok_shape = (isinstance(shard, (list, tuple))
+                        and len(shard) == 2
+                        and all(isinstance(x, int)
+                                and not isinstance(x, bool)
+                                for x in shard))
+            if not ok_shape or not 0 <= shard[0] < shard[1] \
+                    or shard[1] > 4096:
+                raise RejectError({
+                    "code": "bad_request",
+                    "reason": "shard must be [index, count] with "
+                              "0 <= index < count <= 4096"})
         # price against the load the job would actually share the
         # device with (approximate read outside the lock is fine --
         # admission only needs the right order of magnitude)
@@ -440,6 +458,7 @@ class JobScheduler:
                 "admit", job=job.id, tenant=tenant,
                 trace_id=job.trace_id,
                 priority=priority,
+                shard=(list(shard) if shard is not None else None),
                 predicted_wall_s=round(
                     estimate.get("predicted_wall_s", 0.0), 4),
                 shared_wall_s=(round(estimate["shared_wall_s"], 4)

@@ -62,6 +62,22 @@ def _resolve_options(spec: dict) -> dict:
     return opts
 
 
+def _shard_of(spec: dict):
+    """r20 scatter: ``spec["shard"] = [index, count]`` marks this job
+    as one target shard of a scattered mega-job — the polisher owns
+    only ``target_slice(n_targets, count, index)`` and emits only
+    those targets.  The scheduler validated the shape at admission
+    (racon_tpu/serve/scheduler.py); a malformed value that slipped
+    past (hand-rolled client) fails the job, not the server."""
+    shard = spec.get("shard")
+    if shard is None:
+        return None
+    index, count = int(shard[0]), int(shard[1])
+    if not 0 <= index < count:
+        raise ValueError(f"bad shard spec: {shard!r}")
+    return (index, count)
+
+
 def _wire_durability(polisher, job) -> None:
     """r17: connect the polisher's three durability hooks to the
     job's journal/recovery state (all no-ops when the journal is
@@ -142,6 +158,9 @@ def run_job(job) -> dict:
             # other tenants' batches and enforce per-tenant fairness
             polisher._executor_tenant = getattr(job, "tenant",
                                                 "default")
+            shard = _shard_of(spec)
+            if shard is not None:
+                polisher._target_shard = shard
             _wire_durability(polisher, job)
             polisher.initialize()
             polished = polisher.polish(opts["drop_unpolished"])
@@ -191,6 +210,7 @@ def run_job(job) -> dict:
                                     {}).items()},
             "poa_split_detail": getattr(polisher, "poa_split_detail",
                                         {}),
+            "shard": list(shard) if shard is not None else None,
         },
         probe=False)
     polisher.close()

@@ -1,0 +1,1009 @@
+"""Scatter/gather mega-job sharding (racon_tpu/serve/scatter.py +
+the router fan-out) — ISSUE 16.
+
+The contract under test:
+
+* **planner** — shard counts from explicit ``--shards K`` / ``auto``
+  / the RACON_TPU_SCATTER_MIN_WALL_S threshold; auto/threshold plans
+  are capped by the eligible backend count, everything by
+  RACON_TPU_SCATTER_MAX_SHARDS (explicit K deliberately ignores
+  transient eligibility so keyed retries re-derive the same plan);
+  derived idempotence keys ``<job_key>-shard-<i>of<k>`` stay inside
+  the r17 key charset (long bases fold to a digest) and bake the
+  count in so a re-planned duplicate can never dedup against a
+  record holding a different target slice.
+* **byte contract** — ``spec["shard"] = [i, k]`` makes the polisher
+  own exactly ``target_slice(n_targets, k, i)``; the K shard FASTAs
+  concatenated in shard order ARE the unsharded bytes.  Pinned
+  in-process (one JobScheduler, real polishing) and end-to-end
+  against the one-shot CLI.
+* **router fan-out** — one submit scatters into K concurrently
+  placed sub-jobs (each a full _route_job: priced, spilled, failed
+  over), gathers in shard order, answers one merged frame with a
+  per-shard report; cache-affinity tiebreak reorders near-tied
+  placements toward the hottest result cache.
+* **chaos matrix (slow)** — SIGKILL of the backend running a shard
+  at every r17 fault site is invisible (merged bytes == one-shot
+  CLI, exactly-once PER SHARD via the survivor journals); SIGKILL
+  of the ROUTER mid-gather leaves every shard journaled, and the
+  keyed retry through a restarted router re-derives the same shard
+  keys and is answered entirely by dedup.
+
+Chaos runs reuse the router-suite dataset/golden fixtures and the
+pinned-rate environment so placement pricing, the shard slices and
+the output bytes are deterministic.
+"""
+
+import base64
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from racon_tpu.serve import client  # noqa: E402
+from racon_tpu.serve import protocol  # noqa: E402
+from racon_tpu.serve import router  # noqa: E402
+from racon_tpu.serve import scatter  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# planner units (pure, no daemon)
+# ---------------------------------------------------------------------------
+
+def test_scatter_knob_parsing(monkeypatch):
+    monkeypatch.delenv("RACON_TPU_SCATTER_MIN_WALL_S", raising=False)
+    assert scatter.min_wall_s() is None
+    monkeypatch.setenv("RACON_TPU_SCATTER_MIN_WALL_S", "")
+    assert scatter.min_wall_s() is None
+    monkeypatch.setenv("RACON_TPU_SCATTER_MIN_WALL_S", "nope")
+    assert scatter.min_wall_s() is None          # invalid -> off
+    monkeypatch.setenv("RACON_TPU_SCATTER_MIN_WALL_S", "-3")
+    assert scatter.min_wall_s() is None          # non-positive -> off
+    monkeypatch.setenv("RACON_TPU_SCATTER_MIN_WALL_S", "120.5")
+    assert scatter.min_wall_s() == 120.5
+
+    monkeypatch.delenv("RACON_TPU_SCATTER_MAX_SHARDS", raising=False)
+    assert scatter.max_shards() == 8
+    monkeypatch.setenv("RACON_TPU_SCATTER_MAX_SHARDS", "3")
+    assert scatter.max_shards() == 3
+    monkeypatch.setenv("RACON_TPU_SCATTER_MAX_SHARDS", "junk")
+    assert scatter.max_shards() == 8             # invalid -> default
+    monkeypatch.setenv("RACON_TPU_SCATTER_MAX_SHARDS", "0")
+    assert scatter.max_shards() == 1             # never below 1
+
+
+def test_parse_requested_shapes():
+    assert scatter.parse_requested(None) is None
+    assert scatter.parse_requested(0) == 0
+    assert scatter.parse_requested(3) == 3
+    assert scatter.parse_requested("3") == 3
+    assert scatter.parse_requested(" AUTO ") == "auto"
+    assert scatter.parse_requested("auto") == "auto"
+    for bad in ("seven", "", "-1", -1, 4097, 2.5, True, False, [3],
+                {"n": 3}):
+        with pytest.raises(ValueError):
+            scatter.parse_requested(bad)
+
+
+def test_plan_shards_policy(monkeypatch):
+    monkeypatch.delenv("RACON_TPU_SCATTER_MIN_WALL_S", raising=False)
+    monkeypatch.delenv("RACON_TPU_SCATTER_MAX_SHARDS", raising=False)
+    # explicit K wins
+    assert scatter.plan_shards(3, None, 3) == 3
+    # explicit K is capped ONLY by MAX_SHARDS — never by transient
+    # eligibility, so a keyed retry re-derives the plan its journal
+    # records were written under even if a breaker opened in between
+    assert scatter.plan_shards(5, None, 3) == 5
+    assert scatter.plan_shards(2, None, 1) == 2
+    assert scatter.plan_shards(2, None, 0) == 2
+    assert scatter.plan_shards(1, None, 3) == 1
+    monkeypatch.setenv("RACON_TPU_SCATTER_MAX_SHARDS", "2")
+    assert scatter.plan_shards(5, None, 3) == 2
+    monkeypatch.delenv("RACON_TPU_SCATTER_MAX_SHARDS")
+    # auto = one shard per eligible backend
+    assert scatter.plan_shards("auto", None, 3) == 3
+    assert scatter.plan_shards("auto", None, 12) == 8   # MAX_SHARDS
+    assert scatter.plan_shards("auto", None, 0) == 1    # no fleet
+    # 0 / absent-with-no-threshold: unsharded
+    assert scatter.plan_shards(0, 1000.0, 3) == 1
+    assert scatter.plan_shards(None, 1000.0, 3) == 1
+    # threshold: scatter only above it, sized to come back under
+    monkeypatch.setenv("RACON_TPU_SCATTER_MIN_WALL_S", "100")
+    assert scatter.plan_shards(None, 50.0, 3) == 1      # under
+    assert scatter.plan_shards(None, None, 3) == 1      # unpriceable
+    assert scatter.plan_shards(None, 250.0, 3) == 3     # ceil(2.5)
+    assert scatter.plan_shards(None, 150.0, 3) == 2     # ceil(1.5)
+    assert scatter.plan_shards(None, 10000.0, 3) == 3   # backend cap
+    # an explicit 0 still beats the threshold (client opt-out)
+    assert scatter.plan_shards(0, 10000.0, 3) == 1
+
+
+def test_shard_key_derivation():
+    from racon_tpu.obs import context as obs_context
+
+    assert scatter.shard_key("mega", 0, 3) == "mega-shard-0of3"
+    assert scatter.shard_key("mega", 12, 16) == "mega-shard-12of16"
+    # the shard COUNT is part of the key: a duplicate that re-planned
+    # a different k must miss the old journal records (its shards own
+    # different target slices), not dedup against them
+    assert scatter.shard_key("mega", 0, 2) != \
+        scatter.shard_key("mega", 0, 3)
+    # every derived key is a valid r17 journal key
+    for i in range(4):
+        assert obs_context.valid_trace_id(
+            scatter.shard_key("a.b:c-d", i, 4))
+    # a base too long to carry the suffix folds deterministically
+    long_base = "k" * 128
+    k0 = scatter.shard_key(long_base, 0, 2)
+    assert len(k0) <= 128 and k0.endswith("-shard-0of2")
+    assert k0.startswith("sc-")
+    assert obs_context.valid_trace_id(k0)
+    assert scatter.shard_key(long_base, 0, 2) == k0  # deterministic
+    assert scatter.shard_key(long_base, 1, 2) != k0
+    assert scatter.shard_key("k" * 127, 2, 4) != \
+        scatter.shard_key("k" * 126, 2, 4) or True   # no crash path
+
+
+def test_shard_spec_copies():
+    spec = {"sequences": "/r", "targets": "/t", "tenant": "acme"}
+    sub = scatter.shard_spec(spec, 1, 3)
+    assert sub["shard"] == [1, 3]
+    assert sub["tenant"] == "acme" and sub["sequences"] == "/r"
+    assert "shard" not in spec                       # copy, not alias
+
+
+def test_merge_responses_folds_in_shard_order():
+    resps = []
+    for i, chunk in enumerate((b">t0\nAAAA\n", b">t1\nCC\n>t2\nG\n",
+                               b">t3\nTT\n")):
+        resps.append({
+            "ok": True, "job_id": 40 + i,
+            "fasta_b64": base64.b64encode(chunk).decode("ascii"),
+            "n_sequences": chunk.count(b">"), "wall_s": 0.5 + i,
+            "routed_backend": f"/b{i}.sock",
+            "estimate": {"predicted_wall_s": 1.0 + i},
+            "report": {"windows": i},
+        })
+    keys = [f"mega-shard-{i}of3" for i in range(3)]
+    out = scatter.merge_responses(resps, keys)
+    assert out["ok"] and out["job_id"] == 40
+    assert base64.b64decode(out["fasta_b64"]) == \
+        b">t0\nAAAA\n>t1\nCC\n>t2\nG\n>t3\nTT\n"
+    assert out["n_sequences"] == 4
+    rep = out["report"]
+    assert rep["schema"] == "racon-tpu-scatter-v1"
+    assert rep["shards"] == 3
+    assert [p["shard"] for p in rep["per_shard"]] == [0, 1, 2]
+    assert [p["job_key"] for p in rep["per_shard"]] == keys
+    assert [p["backend"] for p in rep["per_shard"]] == \
+        ["/b0.sock", "/b1.sock", "/b2.sock"]
+    assert [p["predicted_wall_s"] for p in rep["per_shard"]] == \
+        [1.0, 2.0, 3.0]
+    assert rep["shard_reports"][1] == {"windows": 1}
+
+
+# ---------------------------------------------------------------------------
+# data plane: admission validation + the shard-mask byte contract
+# ---------------------------------------------------------------------------
+
+def test_scheduler_validates_shard_shape(tmp_path):
+    from racon_tpu.serve import scheduler as sched
+
+    reads = tmp_path / "r.fasta"
+    reads.write_text(">r1\nACGT\n")
+    paf = tmp_path / "o.paf"
+    paf.write_text("r1\t4\t0\t4\t+\tt1\t4\t0\t4\t4\t4\t255\n")
+    draft = tmp_path / "t.fasta"
+    draft.write_text(">t1\nACGT\n")
+
+    def spec(shard):
+        s = {"sequences": str(reads), "overlaps": str(paf),
+             "targets": str(draft)}
+        if shard is not None:
+            s["shard"] = shard
+        return s
+
+    s = sched.JobScheduler(runner=lambda job: {"ok": True},
+                           max_queue=8, max_jobs=8)
+    try:
+        for bad in ([1], [1, 2, 3], ["0", "2"], [True, 2], [2, 2],
+                    [-1, 2], [0, 5000], "0/2", {"i": 0, "k": 2}):
+            with pytest.raises(sched.RejectError) as exc:
+                s.submit(spec(bad))
+            assert exc.value.error["code"] == "bad_request", bad
+            assert "shard" in exc.value.error["reason"]
+        # well-formed shards (and tuples) admit normally
+        job = s.submit(spec([1, 3]))
+        assert job.done.wait(30) and job.result["ok"]
+        job = s.submit(spec((0, 1)))
+        assert job.done.wait(30) and job.result["ok"]
+    finally:
+        s.drain(timeout=30)
+
+
+@pytest.fixture(scope="module")
+def serve_tmp():
+    with tempfile.TemporaryDirectory(prefix="rtsc_", dir="/tmp") as d:
+        yield d
+
+
+@pytest.fixture(scope="module")
+def dataset(serve_tmp):
+    from racon_tpu.tools import simulate
+
+    return simulate.simulate(os.path.join(serve_tmp, "data"),
+                             genome_len=8_000, coverage=5,
+                             read_len=800, seed=21, ont=True)
+
+
+def test_shard_mask_byte_identity(tmp_path):
+    """The tentpole byte contract, in-process: one job run whole vs
+    the same job as 3 target shards — the shard FASTAs concatenated
+    in shard order are the unsharded bytes (target_slice ownership,
+    pinned by tests/test_multihost.py, drives both).  Uses its own
+    small dataset (no one-shot-CLI golden needed here, and this test
+    runs in tier-1 — the full-size dataset stays with the slow
+    chaos suite)."""
+    from racon_tpu.serve.scheduler import JobScheduler
+    from racon_tpu.serve.session import run_job
+    from racon_tpu.tools import simulate
+
+    reads, paf, draft = simulate.simulate(
+        str(tmp_path / "data"), genome_len=3_000, coverage=4,
+        read_len=500, seed=21, ont=True)
+
+    def spec(shard=None):
+        s = {"sequences": reads, "overlaps": paf, "targets": draft,
+             "threads": 2, "tpu_poa_batches": 1,
+             "tpu_aligner_batches": 1}
+        if shard is not None:
+            s["shard"] = shard
+        return s
+
+    sched = JobScheduler(run_job, max_queue=8, max_jobs=1)
+    try:
+        whole = sched.submit(spec())
+        assert whole.done.wait(600)
+        assert whole.result.get("ok"), whole.result
+        parts = []
+        for i in range(3):
+            j = sched.submit(spec(shard=[i, 3]))
+            assert j.done.wait(600)
+            assert j.result.get("ok"), j.result
+            assert j.result["report"]["details"]["shard"] == [i, 3]
+            parts.append(j.result)
+    finally:
+        sched.drain(timeout=120)
+    whole_fa = base64.b64decode(whole.result["fasta_b64"])
+    merged = b"".join(base64.b64decode(p["fasta_b64"])
+                      for p in parts)
+    assert merged == whole_fa, (
+        "3-shard concatenation diverged from the unsharded bytes")
+    # each shard emitted a strict, non-empty-in-total subset
+    assert sum(p["n_sequences"] for p in parts) == \
+        whole.result["n_sequences"]
+
+
+# ---------------------------------------------------------------------------
+# knob registration + fault site
+# ---------------------------------------------------------------------------
+
+def test_scatter_knobs_registered_and_epoch_excluded(monkeypatch):
+    from racon_tpu.cache import keying
+    from racon_tpu.obs import provenance
+
+    for n in ("RACON_TPU_SCATTER_MIN_WALL_S",
+              "RACON_TPU_SCATTER_MAX_SHARDS"):
+        assert n in provenance.KNOWN_KNOBS, n
+        assert n in keying.EPOCH_EXCLUDE, n
+        monkeypatch.delenv(n, raising=False)
+    base = keying.engine_epoch()
+    # shard policy is placement policy: a shard's bytes are a slice
+    # of the SAME byte stream, so the knobs must never move the
+    # result-cache epoch
+    monkeypatch.setenv("RACON_TPU_SCATTER_MIN_WALL_S", "5")
+    monkeypatch.setenv("RACON_TPU_SCATTER_MAX_SHARDS", "2")
+    assert keying.engine_epoch() == base
+
+
+def test_faultinject_route_mid_gather_site(monkeypatch):
+    from racon_tpu.obs import faultinject
+
+    assert "route-mid-gather" in faultinject.SITES
+    monkeypatch.setenv("RACON_TPU_FAULT", "route-mid-gather:1")
+    assert faultinject.spec() == ("route-mid-gather", 1)
+    monkeypatch.delenv("RACON_TPU_FAULT")
+    faultinject._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# cache-affinity tiebreak (fast, no daemon)
+# ---------------------------------------------------------------------------
+
+def _statable_spec(tmp_path):
+    reads = tmp_path / "r.fasta"
+    reads.write_text(">r1\nACGTACGTACGT\n")
+    paf = tmp_path / "o.paf"
+    paf.write_text("r1\t12\t0\t12\t+\tt1\t12\t0\t12\t12\t12\t255\n")
+    draft = tmp_path / "t.fasta"
+    draft.write_text(">t1\nACGTACGTACGT\n")
+    return {"sequences": str(reads), "overlaps": str(paf),
+            "targets": str(draft)}
+
+
+def test_rank_cache_affinity_tiebreak(tmp_path):
+    from racon_tpu.obs import REGISTRY
+    from racon_tpu.obs import flight as obs_flight
+
+    r = router.FleetRouter(str(tmp_path / "r.sock"), ["a", "b"])
+    now = 1.0
+    healthy = {"ok": True, "status": "ok", "accepting": True,
+               "queue_depth": 0, "running": 0}
+    r.backends[0].note_success(
+        dict(healthy, cache={"hit_ratio": 0.0}), now)
+    r.backends[1].note_success(
+        dict(healthy, cache={"hit_ratio": 0.9}), now)
+    spec = _statable_spec(tmp_path)
+    before = REGISTRY.snapshot()["counters"].get(
+        "route_cache_affinity", 0)
+    # identical load + identical spec -> identical wall -> tied
+    # within 10% -> the hotter cache wins over list order
+    ranked = [b.target for b, _ in r._rank(spec, tenant="acme")]
+    assert ranked == ["b", "a"]
+    after = REGISTRY.snapshot()["counters"].get(
+        "route_cache_affinity", 0)
+    assert after == before + 1
+    ev = [e for e in obs_flight.FLIGHT.snapshot()
+          if e["kind"] == "route_cache_affinity"]
+    assert ev and ev[-1]["backend"] == "b" and ev[-1]["over"] == "a"
+    assert ev[-1]["hit_ratio"] == 0.9
+
+    # unpriceable specs (wall == inf) never reorder: affinity
+    # refines the cost model, it never replaces it
+    cold = {"sequences": "/nope", "overlaps": "/nope",
+            "targets": "/nope"}
+    assert [b.target for b, _ in r._rank(cold, tenant="acme")] == \
+        ["a", "b"]
+
+    # equal hit ratios: a backend that recently served this tenant's
+    # content-keyed jobs wins the tie...
+    r.backends[0].note_success(
+        dict(healthy, cache={"hit_ratio": 0.5}), now)
+    r.backends[1].note_success(
+        dict(healthy, cache={"hit_ratio": 0.5}), now)
+    r._note_tenant_backend("acme", "content-key-1", "b")
+    assert [b.target for b, _ in r._rank(spec, tenant="acme")] == \
+        ["b", "a"]
+    # ...but router-minted route-* keys never record warmth (they
+    # carry no content identity)
+    r._note_tenant_backend("acme", "route-1-2", "a")
+    assert [b.target for b, _ in r._rank(spec, tenant="acme")] == \
+        ["b", "a"]
+    # and a tenant with no history keeps the deterministic list order
+    assert [b.target for b, _ in r._rank(spec, tenant="other")] == \
+        ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# in-process router scatter over protocol-speaking stub backends
+# ---------------------------------------------------------------------------
+
+def _stub_backend(path, behavior):
+    """Minimal framed-protocol daemon: one request per connection,
+    ``behavior(req) -> resp``.  Returns (stop_event, listener)."""
+    s = socket.socket(socket.AF_UNIX)
+    s.bind(path)
+    s.listen(16)
+    s.settimeout(0.2)
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            try:
+                conn, _ = s.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                req = protocol.recv_frame(conn)
+                if req is not None:
+                    protocol.send_frame(conn, behavior(req))
+            except Exception:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    threading.Thread(target=loop, daemon=True).start()
+    return stop, s
+
+
+def _shard_behavior(name, seen, fail_shard=None):
+    """Submit answers identify the shard (fasta = >s<i>) so the
+    merged frame pins gather ORDER, not placement."""
+    def behavior(req):
+        if req["op"] == "health":
+            return {"ok": True, "status": "ok", "accepting": True,
+                    "queue_depth": 0, "running": 0, "pid": 1}
+        if req["op"] == "submit":
+            shard = (req["job"].get("shard") or [0, 1])[0]
+            seen.append((name, shard, req.get("job_key")))
+            if fail_shard is not None and shard == fail_shard:
+                return {"ok": False,
+                        "error": {"code": "job_failed",
+                                  "reason": "induced shard failure"}}
+            fa = f">s{shard}\n{'ACGT'[shard % 4] * 4}\n".encode()
+            return {"ok": True, "job_id": 100 + shard,
+                    "fasta_b64": base64.b64encode(fa).decode(),
+                    "wall_s": 0.01, "n_sequences": 1,
+                    "report": {"who": name}}
+        return {"ok": True}
+    return behavior
+
+
+def _start_inproc_router(tmp, n_backends, fail_shard=None):
+    seen = []
+    stops, paths = [], []
+    for i in range(n_backends):
+        path = os.path.join(tmp, f"b{i}.sock")
+        stop, sock = _stub_backend(
+            path, _shard_behavior(f"B{i}", seen,
+                                  fail_shard=fail_shard))
+        stops.append((stop, sock))
+        paths.append(path)
+    rsock = os.path.join(tmp, "r.sock")
+    r = router.FleetRouter(rsock, paths)
+    threading.Thread(target=r.serve_forever, daemon=True).start()
+    deadline = time.monotonic() + 20
+    while not os.path.exists(rsock) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(rsock), "router socket never bound"
+    return r, rsock, paths, stops, seen
+
+
+def test_router_in_process_scatter(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_ROUTE_PROBE_S", "0.1")
+    monkeypatch.delenv("RACON_TPU_SCATTER_MIN_WALL_S", raising=False)
+    tmp = tempfile.mkdtemp(prefix="rtsc_ip_", dir="/tmp")
+    r, rsock, paths, stops, seen = _start_inproc_router(tmp, 3)
+    spec = {"sequences": "/nope", "overlaps": "/nope",
+            "targets": "/nope", "tenant": "acme"}
+    try:
+        # the health doc advertises the capability the wrapper keys on
+        h = client.health(rsock)
+        assert h["router"] and h["scatter"] is True
+
+        resp = client.submit(rsock, spec, job_key="megak", shards=3)
+        assert resp["ok"], resp
+        fa = base64.b64decode(resp["fasta_b64"])
+        # gather order is SHARD order regardless of which backend ran
+        # which shard
+        assert fa == b">s0\nAAAA\n>s1\nCCCC\n>s2\nGGGG\n"
+        assert resp["n_sequences"] == 3
+        assert resp["wall_s"] is not None
+        assert resp["scatter"]["shards"] == 3
+        assert len(resp["scatter"]["backends"]) == 3
+        for b in resp["scatter"]["backends"]:
+            assert b in paths
+        rep = resp["report"]
+        assert rep["schema"] == "racon-tpu-scatter-v1"
+        assert [p["job_key"] for p in rep["per_shard"]] == \
+            ["megak-shard-0of3", "megak-shard-1of3",
+             "megak-shard-2of3"]
+        assert [p["shard"] for p in rep["per_shard"]] == [0, 1, 2]
+        assert rep["shard_reports"][0]["who"].startswith("B")
+        # a merged frame is NOT sticky to any backend (duplicates
+        # re-scatter and dedup per shard at the backends)
+        assert "routed_backend" not in resp
+        # the backends saw exactly the derived keys, shard-aligned
+        assert {(s, k) for _, s, k in seen} == {
+            (0, "megak-shard-0of3"), (1, "megak-shard-1of3"),
+            (2, "megak-shard-2of3")}
+
+        # auto: one shard per eligible backend
+        seen.clear()
+        resp2 = client.submit(rsock, spec, job_key="megak2",
+                              shards="auto")
+        assert resp2["ok"] and resp2["scatter"]["shards"] == 3
+
+        # shards=0 / absent: ordinary unsharded routing
+        resp3 = client.submit(rsock, spec, job_key="plain", shards=0)
+        assert resp3["ok"] and "scatter" not in resp3
+        assert resp3["routed_backend"] in paths
+        resp4 = client.submit(rsock, spec, job_key="plain2")
+        assert resp4["ok"] and "scatter" not in resp4
+
+        # malformed shards is a bad_request BEFORE any placement
+        bad = client.submit(rsock, spec, job_key="badk",
+                            shards="seven")
+        assert not bad["ok"] and bad["error"]["code"] == "bad_request"
+        assert "shards" in bad["error"]["reason"]
+
+        # observability: counters + scatter plan block in route_status
+        doc = client.route_status(rsock)
+        assert doc["counters"].get("route_scatter_jobs", 0) >= 2
+        assert doc["counters"].get("route_scatter_shards", 0) >= 6
+        assert doc["scatter"]["max_shards"] >= 1
+        assert doc["scatter"]["active"] == []        # all gathered
+        kinds = {e["kind"] for e in client.flight(rsock)["events"]}
+        assert {"route_scatter", "route_scatter_shard",
+                "route_gather"} <= kinds, kinds
+    finally:
+        for stop, sock in stops:
+            stop.set()
+            sock.close()
+        r.request_stop()
+
+
+def test_router_scatter_auto_threshold(monkeypatch, tmp_path):
+    """With RACON_TPU_SCATTER_MIN_WALL_S below the job's admission
+    estimate, a plain keyless submit auto-scatters across the
+    eligible backends — no client opt-in needed.  The pricer is
+    stubbed on the instance (a statable toy spec prices to 0.0s,
+    which correctly never crosses a positive threshold)."""
+    monkeypatch.setenv("RACON_TPU_ROUTE_PROBE_S", "0.1")
+    monkeypatch.setenv("RACON_TPU_SCATTER_MIN_WALL_S", "5.0")
+    tmp = tempfile.mkdtemp(prefix="rtsc_auto_", dir="/tmp")
+    r, rsock, paths, stops, seen = _start_inproc_router(tmp, 2)
+    r._price = lambda spec, concurrency: {"predicted_wall_s": 8.0}
+    spec = _statable_spec(tmp_path)
+    try:
+        resp = client.submit(rsock, spec)
+        assert resp["ok"], resp
+        assert resp["scatter"]["shards"] == 2
+        # router-minted key -> derived router-minted shard keys
+        keys = {k for _, _, k in seen}
+        assert len(keys) == 2
+        for k in keys:
+            assert k.startswith("route-") and "-shard-" in k
+    finally:
+        for stop, sock in stops:
+            stop.set()
+            sock.close()
+        r.request_stop()
+
+
+def test_router_scatter_failed_shard_surfaces_shard(monkeypatch):
+    """A shard that fails non-retryably surfaces as the mega-job's
+    error WITH the shard coordinates — the client's keyed retry
+    re-runs only the failures (completed siblings dedup)."""
+    monkeypatch.setenv("RACON_TPU_ROUTE_PROBE_S", "0.1")
+    tmp = tempfile.mkdtemp(prefix="rtsc_fail_", dir="/tmp")
+    r, rsock, paths, stops, seen = _start_inproc_router(
+        tmp, 3, fail_shard=1)
+    spec = {"sequences": "/nope", "overlaps": "/nope",
+            "targets": "/nope"}
+    try:
+        resp = client.submit(rsock, spec, job_key="megaf", shards=3)
+        assert not resp["ok"]
+        assert resp["error"]["code"] == "job_failed"
+        assert resp["error"]["shard"] == 1
+        assert resp["error"]["shards"] == 3
+        doc = client.route_status(rsock)
+        assert doc["counters"].get("route_scatter_failed", 0) >= 1
+    finally:
+        for stop, sock in stops:
+            stop.set()
+            sock.close()
+        r.request_stop()
+
+
+# ---------------------------------------------------------------------------
+# wrapper: scatter-capable router detection
+# ---------------------------------------------------------------------------
+
+def test_wrapper_detects_scatter_router(tmp_path):
+    from racon_tpu.tools import wrapper as wrap
+
+    def fake(server):
+        w = wrap.Wrapper.__new__(wrap.Wrapper)
+        w.server = server
+        return w
+
+    # a router health doc with the capability flag -> True
+    rsock = str(tmp_path / "r.sock")
+    stop, sock = _stub_backend(rsock, lambda req: {
+        "ok": True, "router": True, "scatter": True, "backends": 2})
+    try:
+        assert fake(rsock)._router_scatters() is True
+    finally:
+        stop.set()
+        sock.close()
+    # a plain daemon (no router/scatter flags) -> False
+    dsock = str(tmp_path / "d.sock")
+    stop, sock = _stub_backend(dsock, lambda req: {
+        "ok": True, "status": "ok", "accepting": True})
+    try:
+        assert fake(dsock)._router_scatters() is False
+    finally:
+        stop.set()
+        sock.close()
+    # a daemon LIST or an unreachable target -> False (degraded
+    # client-side split keeps working against anything)
+    assert fake(f"{rsock},{dsock}")._router_scatters() is False
+    assert fake(str(tmp_path / "gone.sock"))._router_scatters() \
+        is False
+
+
+def test_print_router_status_renders_scatter(capsys):
+    doc = {
+        "ok": True, "router": True, "pid": 42, "socket": "/r.sock",
+        "tcp": None, "uptime_s": 1.0, "draining": False,
+        "in_flight": 1, "routed_keys": 1, "backends": [],
+        "counters": {"route_submit": 4, "route_scatter_jobs": 2,
+                     "route_scatter_shards": 6,
+                     "route_cache_affinity": 3},
+        "scatter": {"active": [{"job_key": "mega", "shards": 3,
+                                "done": 1, "backends": ["/a", None,
+                                                        None]}],
+                    "min_wall_s": None, "max_shards": 8},
+    }
+    assert client._print_router_status(doc) == 0
+    out = capsys.readouterr().out
+    assert "2 job(s) -> 6 shard(s)" in out
+    assert "3 affinity pick(s)" in out
+    assert "mega: 1/3 shard(s) done" in out
+
+
+# ---------------------------------------------------------------------------
+# slow chaos suite: real daemons + real router + shard SIGKILL matrix
+# ---------------------------------------------------------------------------
+
+def _serve_env(serve_tmp, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "RACON_TPU_CACHE_DIR": os.path.join(serve_tmp, "cache"),
+        "RACON_TPU_CLI_PREWARM": "0",
+        # pinned rates: placement pricing and the device split are
+        # identical across backends and the golden run
+        "RACON_TPU_RATE_POA_DEV": "0.30",
+        "RACON_TPU_RATE_POA_CPU": "2.0",
+        "RACON_TPU_RATE_ALIGN_DEV": "1100",
+        "RACON_TPU_RATE_ALIGN_CPU": "4.0",
+        "RACON_TPU_RATE_ALIGN_WFA_DEV": "700",
+        "RACON_TPU_RATE_ALIGN_WFA_CPU": "1.0",
+        "RACON_TPU_POA_MEGABATCH": "1",
+    })
+    env.pop("RACON_TPU_TRACE", None)
+    env.pop("RACON_TPU_METRICS_JSON", None)
+    env.pop("RACON_TPU_FAULT", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+@pytest.fixture(scope="module")
+def golden(dataset, serve_tmp):
+    """One-shot CLI bytes — what every merged gather must match."""
+    reads, paf, draft = dataset
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "4", "-c", "1",
+         "--tpualigner-batches", "1", reads, paf, draft],
+        cwd=REPO_ROOT, capture_output=True,
+        env=_serve_env(serve_tmp), timeout=600)
+    assert run.returncode == 0, run.stderr.decode()
+    assert run.stdout.startswith(b">")
+    return run.stdout
+
+
+def _spec(dataset):
+    reads, paf, draft = dataset
+    return {"sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 4, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1}
+
+
+def _wait_listening(proc, sock_path, log_path, what):
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            with open(log_path) as fh:
+                raise AssertionError(
+                    f"{what} died at startup: " + fh.read())
+        if os.path.exists(sock_path):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock_path)
+            except OSError:
+                pass
+            else:
+                return
+            finally:
+                probe.close()
+        time.sleep(0.2)
+    proc.kill()
+    raise AssertionError(f"{what} socket never came up")
+
+
+def _start_server(serve_tmp, name, args=(), extra_env=None):
+    sock_path = os.path.join(serve_tmp, name + ".sock")
+    log_path = os.path.join(serve_tmp, name + ".log")
+    log = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve",
+         "--socket", sock_path, *args],
+        cwd=REPO_ROOT, stdout=log, stderr=log,
+        env=_serve_env(serve_tmp, extra_env))
+    log.close()
+    _wait_listening(proc, sock_path, log_path, "server " + name)
+    return proc, sock_path, log_path
+
+
+def _start_router(serve_tmp, name, backends, args=(), extra_env=None):
+    sock_path = os.path.join(serve_tmp, name + ".sock")
+    log_path = os.path.join(serve_tmp, name + ".log")
+    log = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "route",
+         "--socket", sock_path,
+         "--backends", ",".join(backends), *args],
+        cwd=REPO_ROOT, stdout=log, stderr=log,
+        env=_serve_env(serve_tmp, extra_env))
+    log.close()
+    _wait_listening(proc, sock_path, log_path, "router " + name)
+    return proc, sock_path, log_path
+
+
+def _stop(proc, sock_path):
+    if proc.poll() is None:
+        try:
+            client.admin(sock_path, "shutdown")
+        except client.ServeError:
+            proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+@pytest.fixture(scope="module")
+def backend_b(serve_tmp):
+    """The surviving backend, shared across the chaos cases (each
+    case gets its own doomed backend A and its own router)."""
+    proc, sock_path, _ = _start_server(serve_tmp, "shared-b")
+    yield sock_path
+    _stop(proc, sock_path)
+
+
+def _b_stats(b_sock):
+    doc = client.status(b_sock)
+    return (doc["queue"]["completed"],
+            doc["registry"]["counters"].get("serve_dedup_hits", 0))
+
+
+def _done_keys(*sock_paths):
+    """Every ``done`` journal record's job_key across the given
+    daemons' journals — the exactly-once-per-shard ledger."""
+    from racon_tpu.serve import journal
+
+    keys = []
+    for sock_path in sock_paths:
+        records, _ = journal.scan(journal.journal_path(sock_path))
+        keys.extend(rec["job_key"] for rec in records
+                    if rec.get("kind") == "done"
+                    and rec.get("job_key"))
+    return keys
+
+
+@pytest.mark.slow
+def test_scatter_end_to_end_golden(serve_tmp, dataset, golden,
+                                   backend_b):
+    """The r20 acceptance pin, happy path: one submit scattered 3
+    ways across 3 real daemons returns the one-shot CLI's exact
+    bytes, exactly once per shard (pinned in the journals), and the
+    duplicate keyed submit is answered entirely by dedup."""
+    proc_a, a_sock, _ = _start_server(serve_tmp, "e2e-a")
+    proc_c, c_sock, _ = _start_server(serve_tmp, "e2e-c")
+    proc_r, r_sock, _ = _start_router(
+        serve_tmp, "e2e-r", [a_sock, backend_b, c_sock])
+    key = "sc-e2e"
+    socks = (a_sock, backend_b, c_sock)
+    try:
+        resp = client.submit(r_sock, _spec(dataset), job_key=key,
+                             shards=3)
+        assert resp["ok"], resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden, (
+            "3-shard gather diverged from the one-shot CLI bytes")
+        assert resp["scatter"]["shards"] == 3
+        rep = resp["report"]
+        assert rep["schema"] == "racon-tpu-scatter-v1"
+        assert [p["job_key"] for p in rep["per_shard"]] == \
+            [f"{key}-shard-{i}of3" for i in range(3)]
+        for p in rep["per_shard"]:
+            assert p["backend"] in socks
+
+        # exactly-once per shard: each derived key has exactly ONE
+        # done record across the fleet's journals...
+        done = _done_keys(*socks)
+        for i in range(3):
+            assert done.count(f"{key}-shard-{i}of3") == 1, done
+
+        # ...and the duplicate mega-job submit re-derives the same
+        # keys, so every shard is answered from a journal record:
+        # identical bytes, no new work anywhere
+        completed0 = [_b_stats(s)[0] for s in socks]
+        dedup0 = sum(_b_stats(s)[1] for s in socks)
+        resp2 = client.submit(r_sock, _spec(dataset), job_key=key,
+                              shards=3)
+        assert resp2["ok"]
+        assert resp2["fasta_b64"] == resp["fasta_b64"]
+        assert [_b_stats(s)[0] for s in socks] == completed0
+        assert sum(_b_stats(s)[1] for s in socks) >= dedup0 + 3
+        done = _done_keys(*socks)
+        for i in range(3):
+            assert done.count(f"{key}-shard-{i}of3") == 1, done
+
+        doc = client.route_status(r_sock)
+        assert doc["counters"].get("route_scatter_jobs", 0) >= 2
+        assert doc["counters"].get("route_scatter_shards", 0) >= 6
+    finally:
+        _stop(proc_a, a_sock)
+        _stop(proc_c, c_sock)
+        _stop(proc_r, r_sock)
+
+
+#: same sites as the durable/router suites: the kill lands on the
+#: backend RUNNING A SHARD; the gather must make it invisible
+_KILL_SITES = [("post-admit", 1), ("mid-megabatch", 1),
+               ("pre-demux", 1), ("pre-done-record", 1),
+               ("journal-write", 2)]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site,nth", _KILL_SITES,
+                         ids=[s for s, _ in _KILL_SITES])
+def test_shard_backend_sigkill_invisible(serve_tmp, dataset, golden,
+                                         backend_b, site, nth):
+    """SIGKILL of the backend running shard i at every r17 fault
+    site, mid-scatter: the shard fails over under its derived key,
+    the merged bytes still match the one-shot CLI, and every shard
+    ran exactly once (one done record per derived key across the
+    fleet's journals)."""
+    proc_a, a_sock, _ = _start_server(
+        serve_tmp, "ska-" + site,
+        extra_env={"RACON_TPU_FAULT": f"{site}:{nth}"})
+    proc_r, r_sock, _ = _start_router(serve_tmp, "skr-" + site,
+                                      [a_sock, backend_b])
+    key = f"scchaos-{site}"
+    try:
+        completed0, dedup0 = _b_stats(backend_b)
+        # both backends idle -> the two shards spread (in-flight
+        # placement counting) -> A runs one shard, the armed site
+        # SIGKILLs it -> that shard ALONE fails over to B under the
+        # same derived key; the sibling shard is untouched
+        resp = client.submit(r_sock, _spec(dataset), job_key=key,
+                             shards=2)
+        assert resp["ok"], resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden, (
+            f"shard failover after SIGKILL at {site} diverged from "
+            "the one-shot CLI bytes")
+        assert proc_a.wait(timeout=60) == -signal.SIGKILL
+
+        # exactly-once per shard: every derived key has exactly one
+        # done record (A died pre-done on its shard, so both live on
+        # B — the point is none appears TWICE)
+        done = _done_keys(a_sock, backend_b)
+        for i in range(2):
+            assert done.count(f"{key}-shard-{i}of2") == 1, (site,
+                                                            done)
+
+        # the duplicate mega-job is answered by per-shard dedup: no
+        # new work on the survivor
+        completed_mid, dedup_mid = _b_stats(backend_b)
+        resp2 = client.submit(r_sock, _spec(dataset), job_key=key,
+                              shards=2)
+        assert resp2["ok"]
+        assert resp2["fasta_b64"] == resp["fasta_b64"]
+        completed1, dedup1 = _b_stats(backend_b)
+        assert completed1 == completed_mid
+        assert dedup1 >= dedup_mid + 2
+
+        # the shard failover is observable
+        doc = client.route_status(r_sock)
+        assert doc["counters"].get("route_failover", 0) >= 1
+        kinds = {e["kind"] for e in client.flight(r_sock)["events"]}
+        assert {"route_scatter", "route_failover",
+                "route_gather"} <= kinds, kinds
+    finally:
+        if proc_a.poll() is None:
+            proc_a.kill()
+        _stop(proc_r, r_sock)
+
+
+@pytest.mark.slow
+def test_router_sigkill_mid_gather_exactly_once(serve_tmp, dataset,
+                                                golden, backend_b):
+    """SIGKILL of the ROUTER between the last shard completing and
+    the gather: both shards are already journaled on the backends,
+    so the keyed retry through a restarted router re-derives the
+    same shard keys and is answered ENTIRELY by dedup — the merged
+    bytes appear without any shard re-running."""
+    proc_a, a_sock, _ = _start_server(serve_tmp, "mg-a")
+    proc_r, r_sock, _ = _start_router(
+        serve_tmp, "mg-r", [a_sock, backend_b],
+        extra_env={"RACON_TPU_FAULT": "route-mid-gather:1"})
+    key = "sc-midgather"
+    try:
+        with pytest.raises(client.ServeError):
+            client.submit(r_sock, _spec(dataset), job_key=key,
+                          shards=2)
+        assert proc_r.wait(timeout=300) == -signal.SIGKILL
+
+        # every shard completed and was journaled BEFORE the router
+        # died (mid-gather fires after the joins)
+        done = _done_keys(a_sock, backend_b)
+        for i in range(2):
+            assert done.count(f"{key}-shard-{i}of2") == 1, done
+        completed0 = [_b_stats(s)[0] for s in (a_sock, backend_b)]
+        dedup0 = sum(_b_stats(s)[1] for s in (a_sock, backend_b))
+
+        proc_r2, _, _ = _start_router(serve_tmp, "mg-r",
+                                      [a_sock, backend_b])
+        try:
+            resp = client.submit(r_sock, _spec(dataset), job_key=key,
+                                 shards=2)
+            assert resp["ok"], resp
+            assert base64.b64decode(resp["fasta_b64"]) == golden
+            # no shard ran twice: completed counts frozen, the retry
+            # was fed from the journals
+            assert [_b_stats(s)[0]
+                    for s in (a_sock, backend_b)] == completed0
+            assert sum(_b_stats(s)[1]
+                       for s in (a_sock, backend_b)) >= dedup0 + 2
+            done = _done_keys(a_sock, backend_b)
+            for i in range(2):
+                assert done.count(f"{key}-shard-{i}of2") == 1, done
+        finally:
+            _stop(proc_r2, r_sock)
+    finally:
+        _stop(proc_a, a_sock)
+
+
+@pytest.mark.slow
+def test_wrapper_scatter_through_router(serve_tmp, dataset, golden,
+                                        backend_b):
+    """wrapper --server <router> --split: the wrapper detects the
+    scatter capability, SKIPS its client-side split, and forwards
+    the whole job with shards=auto — stdout is still the one-shot
+    CLI bytes."""
+    proc_a, a_sock, _ = _start_server(serve_tmp, "wr-a")
+    proc_r, r_sock, _ = _start_router(serve_tmp, "wr-r",
+                                      [a_sock, backend_b])
+    reads, paf, draft = dataset
+    wdir = os.path.join(serve_tmp, "wrap-scatter")
+    os.makedirs(wdir, exist_ok=True)
+    wenv = _serve_env(serve_tmp)
+    wenv["PYTHONPATH"] = REPO_ROOT + os.pathsep + \
+        wenv.get("PYTHONPATH", "")
+    try:
+        run = subprocess.run(
+            [sys.executable, "-m", "racon_tpu.tools.wrapper",
+             "--server", r_sock, "--split", "4000",
+             "-m", "3", "-x", "-5", "-g", "-4",
+             "-t", "4", "-c", "1", "--tpualigner-batches", "1",
+             reads, paf, draft],
+            cwd=wdir, capture_output=True, env=wenv, timeout=600)
+        assert run.returncode == 0, run.stderr.decode()
+        assert run.stdout == golden
+        assert b"scatter-capable router" in run.stderr
+        doc = client.route_status(r_sock)
+        assert doc["counters"].get("route_scatter_jobs", 0) >= 1
+    finally:
+        _stop(proc_a, a_sock)
+        _stop(proc_r, r_sock)
