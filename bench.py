@@ -1111,7 +1111,12 @@ def route_scatter_bench():
     ``route_scatter_speedup`` (unsharded wall / sharded wall),
     ``route_scatter_efficiency`` (speedup / shards), per-shard
     walls, and the byte-identity bit (concatenated shard FASTA ==
-    unsharded FASTA).  Default ON (RACON_TPU_BENCH_ROUTE_SCATTER=0
+    unsharded FASTA).  r21 adds the staged twin: the same shards
+    re-run with ``RACON_TPU_STAGE=1`` (ranged overlap parsing via
+    the slice index), reporting ``route_scatter_staged_speedup`` and
+    per-shard ``host.parse_s`` for both twins; any byte divergence
+    between staged, unstaged, and unsharded FASTA hard-fails the
+    leg.  Default ON (RACON_TPU_BENCH_ROUTE_SCATTER=0
     disables); on hostless CPU backends the rate metrics are
     provenance-marked — the native engines parallelize across
     processes/cores, so a single-core CI container measures gather
@@ -1149,21 +1154,37 @@ def route_scatter_bench():
                 f"route_scatter unsharded job failed: {job.result}")
         return wall, job.result["fasta_b64"]
 
-    def sharded(reads, paf, draft):
+    def _shard_parse_s(result):
+        run = (result.get("report") or {}).get("run") or {}
+        for block in ("counters", "gauges"):
+            v = (run.get(block) or {}).get("host.parse_s")
+            if v is not None:
+                return round(float(v), 3)
+        return None
+
+    def sharded(reads, paf, draft, staged):
         _cold_result_cache()
-        scheds = [JobScheduler(run_job, max_queue=1, max_jobs=1)
-                  for _ in range(n_shards)]
-        t0 = time.monotonic()
-        jobs = []
-        for i, sched in enumerate(scheds):
-            spec = base_spec(reads, paf, draft)
-            spec["shard"] = [i, n_shards]
-            jobs.append(sched.submit(spec))
-        for j in jobs:
-            j.done.wait()
-        wall = time.monotonic() - t0
-        for sched in scheds:
-            sched.drain(timeout=120)
+        prior_stage = os.environ.get("RACON_TPU_STAGE")
+        os.environ["RACON_TPU_STAGE"] = "1" if staged else "0"
+        try:
+            scheds = [JobScheduler(run_job, max_queue=1, max_jobs=1)
+                      for _ in range(n_shards)]
+            t0 = time.monotonic()
+            jobs = []
+            for i, sched in enumerate(scheds):
+                spec = base_spec(reads, paf, draft)
+                spec["shard"] = [i, n_shards]
+                jobs.append(sched.submit(spec))
+            for j in jobs:
+                j.done.wait()
+            wall = time.monotonic() - t0
+            for sched in scheds:
+                sched.drain(timeout=120)
+        finally:
+            if prior_stage is None:
+                os.environ.pop("RACON_TPU_STAGE", None)
+            else:
+                os.environ["RACON_TPU_STAGE"] = prior_stage
         for i, j in enumerate(jobs):
             if not (j.result or {}).get("ok"):
                 raise RuntimeError(
@@ -1172,7 +1193,9 @@ def route_scatter_bench():
         fasta = b"".join(base64.b64decode(j.result["fasta_b64"])
                          for j in jobs)
         walls = [round(j.result["wall_s"], 3) for j in jobs]
-        return wall, base64.b64encode(fasta).decode("ascii"), walls
+        parse = [_shard_parse_s(j.result) for j in jobs]
+        return (wall, base64.b64encode(fasta).decode("ascii"),
+                walls, parse)
 
     with tempfile.TemporaryDirectory(
             prefix="racon_scatter_") as tmp:
@@ -1180,9 +1203,21 @@ def route_scatter_bench():
             tmp, genome_len=120_000, coverage=8, read_len=5000,
             seed=29)
         one_wall, one_fasta = unsharded(reads, paf, draft)
-        k_wall, k_fasta, shard_walls = sharded(reads, paf, draft)
+        k_wall, k_fasta, shard_walls, parse_full = sharded(
+            reads, paf, draft, staged=False)
+        s_wall, s_fasta, s_shard_walls, parse_staged = sharded(
+            reads, paf, draft, staged=True)
     _cold_result_cache()
+    # staging must never change bytes: the staged twin's concatenated
+    # FASTA == the unstaged twin's == the unsharded run's.  This is
+    # the bench's hard-fail — a perf leg that altered output is a
+    # correctness bug, not a slow run
+    if not (k_fasta == one_fasta and s_fasta == one_fasta):
+        raise RuntimeError(
+            "route_scatter bytes diverged: staged/unstaged/unsharded "
+            "FASTAs are not identical")
     speedup = round(one_wall / max(k_wall, 1e-9), 3)
+    staged_speedup = round(one_wall / max(s_wall, 1e-9), 3)
     out = {
         "route_scatter_shards": n_shards,
         "route_scatter_unsharded_wall_s": round(one_wall, 3),
@@ -1190,9 +1225,16 @@ def route_scatter_bench():
         "route_scatter_shard_walls_s": shard_walls,
         "route_scatter_speedup": speedup,
         "route_scatter_efficiency": round(speedup / n_shards, 4),
-        # sharding must never change bytes: shard FASTAs
-        # concatenated in shard order == the unsharded FASTA
-        "route_scatter_bytes_equal": k_fasta == one_fasta,
+        # r21 staged twin: same shards with RACON_TPU_STAGE=1 — the
+        # per-shard parse walls are the staging win isolated from
+        # compute, and the twin speedups make regressions in the
+        # slice-index path show as staged_speedup < speedup
+        "route_scatter_staged_wall_s": round(s_wall, 3),
+        "route_scatter_staged_shard_walls_s": s_shard_walls,
+        "route_scatter_staged_speedup": staged_speedup,
+        "route_scatter_parse_s": parse_full,
+        "route_scatter_staged_parse_s": parse_staged,
+        "route_scatter_bytes_equal": True,
     }
     if jax.devices()[0].platform != "tpu":
         # in-process shard concurrency on a CPU backend shares the
@@ -1202,9 +1244,12 @@ def route_scatter_bench():
         prov = f"cpu-backend:{os.cpu_count() or 1}-core"
         out["route_scatter_speedup_provenance"] = prov
         out["route_scatter_efficiency_provenance"] = prov
+        out["route_scatter_staged_speedup_provenance"] = prov
     log(f"[bench] route_scatter: unsharded {one_wall:.1f}s vs "
         f"{n_shards}-shard {k_wall:.1f}s (speedup {speedup:.2f}x, "
-        f"shard walls {shard_walls}); bytes equal: "
+        f"shard walls {shard_walls}) vs staged {s_wall:.1f}s "
+        f"(speedup {staged_speedup:.2f}x, parse "
+        f"{parse_staged} vs {parse_full}); bytes equal: "
         f"{out['route_scatter_bytes_equal']}")
     return out
 

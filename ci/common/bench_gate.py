@@ -89,6 +89,7 @@ RATE_METRICS = (
     "serve_sat_jobs_per_s",
     "serve_cache_warm_jobs_per_s",
     "route_scatter_speedup",
+    "route_scatter_staged_speedup",
 )
 
 #: absolute slack for edit-distance drift on top of the relative tol

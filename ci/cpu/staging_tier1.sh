@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# Shard-aware staging lane (ISSUE 17 CI satellite): the whole tier-1
+# sweep re-run with staged input parsing pinned ON
+# (RACON_TPU_STAGE=1), so every byte-determinism golden, parser fuzz,
+# scatter contract, and serve/journal pin in the fast suite holds
+# with ranged overlap scanning exactly as it does with the full
+# parse.  Staging is policy, never bytes — this lane is the
+# fleet-wide proof.
+#
+# On top of the sweep, a staged-vs-unstaged byte-identity smoke
+# against the one-shot CLI: the same dataset polished (a) whole
+# through `python -m racon_tpu.cli` (full parse — the reference
+# bytes), (b) as 3 target shards with RACON_TPU_STAGE=1, and (c) as
+# the same 3 shards with RACON_TPU_STAGE=0; both concatenations must
+# equal the CLI bytes exactly.  A staging regression that slipped
+# past the unit fuzz (e.g. an index/parser coordinate mismatch only
+# visible at wiring level) fails the lane on a cmp, not on a
+# downstream golden.
+#
+# Hardening mirrors the sibling lanes:
+#   * JAX_PLATFORMS=cpu + virtual devices (tests/conftest.py)
+#     exercises sharded dispatch without hardware;
+#   * PYTHONDEVMODE=1 surfaces unclosed scan parsers/mmaps the
+#     ranged path might leak;
+#   * pytest's faulthandler timeout dumps all threads on a hang.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export PYTHONDEVMODE=1
+export RACON_TPU_STAGE=1
+unset RACON_TPU_FAULT || true
+python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"
+
+tmp="$(mktemp -d "${TMPDIR:-/tmp}/racon_staging.XXXXXX")"
+trap 'rm -rf "$tmp"' EXIT
+python - "$tmp" <<'EOF'
+import sys
+from racon_tpu.tools import simulate
+simulate.simulate(sys.argv[1], genome_len=24_000, coverage=5,
+                  read_len=2000, seed=31, ont=True)
+EOF
+JAX_PLATFORMS=cpu python -m racon_tpu.cli -t 2 \
+    "$tmp/reads.fastq" "$tmp/reads2draft.paf" "$tmp/draft.fasta" \
+    > "$tmp/cli.fasta"
+for stage in 1 0; do
+    JAX_PLATFORMS=cpu RACON_TPU_STAGE=$stage python - "$tmp" <<'EOF'
+import sys
+tmp = sys.argv[1]
+from racon_tpu.core.polisher import PolisherType, create_polisher
+out = b""
+for i in range(3):
+    p = create_polisher(
+        f"{tmp}/reads.fastq", f"{tmp}/reads2draft.paf",
+        f"{tmp}/draft.fasta", PolisherType.kC, 500, 10.0, 0.3,
+        True, 3, -5, -4, 2, 0, False, 0)
+    p._target_shard = (i, 3)
+    p.initialize()
+    for s in p.polish(True):
+        out += b">" + s.name.encode() + b"\n" + s.data + b"\n"
+    p.close()
+import os
+with open(f"{tmp}/shards_stage{os.environ['RACON_TPU_STAGE']}.fasta",
+          "wb") as fh:
+    fh.write(out)
+EOF
+done
+cmp "$tmp/cli.fasta" "$tmp/shards_stage1.fasta"
+cmp "$tmp/cli.fasta" "$tmp/shards_stage0.fasta"
+echo "staging_tier1: staged == full parse == one-shot CLI"

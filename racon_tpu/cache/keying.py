@@ -42,6 +42,7 @@ EPOCH_EXCLUDE = frozenset({
     "RACON_TPU_CACHE_MB",
     "RACON_TPU_CACHE_PERSIST",
     "RACON_TPU_CACHE_DIR",
+    "RACON_TPU_XLA_CACHE_DIR",
     # observability planes (pinned byte-identical on/off)
     "RACON_TPU_TRACE",
     "RACON_TPU_METRICS_JSON",
@@ -73,6 +74,11 @@ EPOCH_EXCLUDE = frozenset({
     # byte-identical to the unsharded run (target_slice contract)
     "RACON_TPU_SCATTER_MIN_WALL_S",
     "RACON_TPU_SCATTER_MAX_SHARDS",
+    # r21: staged parsing is pinned byte-identical to the full parse
+    # (tests/test_fastio.py fuzz + tests/test_scatter.py), and the
+    # straggler factor only moves WHERE a shard's attempt runs
+    "RACON_TPU_STAGE",
+    "RACON_TPU_SCATTER_REBALANCE",
 })
 
 DIGEST_SIZE = 32

@@ -30,6 +30,14 @@ from racon_tpu.utils.logger import Logger
 CHUNK_SIZE = 1024 * 1024 * 1024  # reference kChunkSize (polisher.cpp:26)
 
 
+class JobCanceledError(RuntimeError):
+    """The serve tier canceled this job (r21 straggler rebalancing:
+    the router superseded a slow shard with a replacement attempt and
+    sent best-effort ``cancel`` to the original).  Raised from the
+    polisher's cancel poll sites — always BETWEEN committed units, so
+    a canceled job's journal/checkpoint state stays consistent."""
+
+
 class PolisherType(enum.Enum):
     kC = 0  # contig polishing
     kF = 1  # fragment (read) error correction
@@ -97,6 +105,12 @@ class Polisher:
         # target-sharded sub-job; initialize() turns it into the same
         # target_slice ownership mask the multi-host path uses
         self._target_shard = None
+        # r21 serve seams (racon_tpu/serve/session.py wires both):
+        # a staged-input hint shipped with a scattered sub-job
+        # (spec["stage"] -> ranged overlap scan), and a cancel poll
+        # the straggler rebalancer uses to stop a superseded original
+        self._stage_hint = None
+        self._cancel_check = None
         # streaming bookkeeping (racon_tpu/tpu/polisher.py pipeline):
         # window-id offsets per target, and whether the subclass
         # already counted per-target coverages at registration time
@@ -277,9 +291,49 @@ class Polisher:
         self.logger.log("[racon_tpu::Polisher::initialize] transformed data "
                         "into windows")
 
+    def _poll_cancel(self) -> None:
+        """Raise :class:`JobCanceledError` if the serve tier flagged
+        this job canceled (r21 rebalancing).  Poll sites sit between
+        committed units only, so cancellation never tears a unit."""
+        if self._cancel_check is not None and self._cancel_check():
+            raise JobCanceledError("job canceled by the serve tier")
+
+    def _configure_stage(self):
+        """Apply the r21 staged-input plan to the overlap parser
+        before the parse: a validated router-shipped hint wins; a
+        sharded polisher with no (valid) hint self-builds the index
+        from its own line tables; anything that cannot be exact —
+        staging off, line parsers, non-PAF input, malformed rows —
+        falls back to the unchanged full parse by returning None."""
+        from racon_tpu.io import staging
+
+        if self._owned_targets is None or not staging.stage_enabled() \
+                or not hasattr(self.oparser, "set_stage"):
+            return None
+        plan = None
+        if self._stage_hint is not None:
+            plan = staging.plan_from_hint(
+                self._stage_hint, self.oparser.path, self._target_shard)
+        if plan is None:
+            names = [self.sequences[i].name
+                     for i in range(self._targets_size)]
+            index = staging.get_index(self.oparser.path, names)
+            if index is None:
+                return None
+            plan = index.ranges_for(self._owned_targets)
+            plan["total_bytes"] = index.total_bytes
+        self.oparser.set_stage(plan["ranges"])
+        self.metrics.set("host.staged_bytes",
+                         int(plan.get("staged_bytes", 0)))
+        self.metrics.set("host.parse_skipped_bytes",
+                         max(0, int(plan.get("total_bytes", 0))
+                             - int(plan.get("staged_bytes", 0))))
+        return plan
+
     def _load_overlaps(self, name_to_id, id_to_id, has_data,
                        has_reverse_data) -> List[Overlap]:
         """Stream overlaps, transmute, and filter (polisher.cpp:283-354)."""
+        self._configure_stage()
         overlaps: List[Optional[Overlap]] = []
 
         def remove_invalid(begin: int, end: int) -> None:
@@ -400,6 +454,7 @@ class Polisher:
         results = []
         step = len(futures) // 20
         for i, f in enumerate(futures):
+            self._poll_cancel()
             results.append(f.result())
             if step != 0 and (i + 1) % step == 0 and (i + 1) // step < 20:
                 self.logger.bar(bar_message)

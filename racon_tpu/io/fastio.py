@@ -304,13 +304,55 @@ class FastqScanParser(_ScanParserBase):
 
 
 class _OverlapScanParser(_ScanParserBase):
-    """Shared chunking + per-row fallback for the overlap formats."""
+    """Shared chunking + per-row fallback for the overlap formats.
+
+    r21 staged scanning (racon_tpu/io/staging.py): ``set_stage``
+    restricts record MATERIALIZATION to the given line ranges while
+    the budget/chunk arithmetic keeps counting every line exactly as
+    before — chunk boundaries, the parse() return value, and the
+    global line numbering in malformed-row diagnostics are identical
+    to the full parse; only rows outside the ranges are skipped (and
+    accounted in ``stage_skipped_bytes``)."""
 
     #: the matching line parser class; supplies ``record_from_line``
     line_parser = None
 
     def _post_reset(self) -> None:
         self._cursor = 0
+        self._stage_mask = None
+        self.stage_skipped_bytes = 0
+        if not hasattr(self, "_stage"):
+            #: configured line ranges; survives reset() — staging is
+            #: parser configuration, not per-parse cursor state
+            self._stage = None
+
+    def set_stage(self, ranges) -> None:
+        """Materialize records only for lines inside the ``[lo, hi)``
+        ranges (ascending, non-overlapping).  ``None`` restores the
+        full parse.  Line indices count PHYSICAL lines of the
+        (decompressed) buffer, the same table the budget walks."""
+        self._stage = (None if ranges is None else
+                       [(int(lo), int(hi)) for lo, hi in ranges])
+        self._stage_mask = None
+
+    def _select_rows(self, a: int, b: int):
+        """The nonempty rows of lines [a, b) that the stage admits,
+        with skipped (nonempty, out-of-range) bytes accounted."""
+        s, e = self._starts[a:b], self._ends[a:b]
+        rows = np.flatnonzero(e > s)
+        if self._stage is not None and rows.size:
+            if self._stage_mask is None:
+                m = np.zeros(self._starts.size, dtype=bool)
+                for lo, hi in self._stage:
+                    m[lo:hi] = True
+                self._stage_mask = m
+            keep = self._stage_mask[a:b][rows]
+            dropped = rows[~keep]
+            if dropped.size:
+                self.stage_skipped_bytes += int(
+                    (self._rawnext[a:b][dropped] - s[dropped]).sum())
+            rows = rows[keep]
+        return s, e, rows
 
     def parse(self, dst: List[Overlap], max_bytes: int) -> bool:
         self._ensure_scanned()
@@ -375,8 +417,7 @@ class PafScanParser(_OverlapScanParser):
     line_parser = _line.PafParser
 
     def _parse_lines(self, dst: List[Overlap], a: int, b: int) -> None:
-        s, e = self._starts[a:b], self._ends[a:b]
-        rows = np.flatnonzero(e > s)
+        s, e, rows = self._select_rows(a, b)
         if rows.size == 0:
             return
         ls, le = s[rows], e[rows]
@@ -438,8 +479,7 @@ class MhapScanParser(_OverlapScanParser):
     _INT_TOKENS = (0, 1, 4, 5, 6, 7, 8, 9, 10, 11)
 
     def _parse_lines(self, dst: List[Overlap], a: int, b: int) -> None:
-        s, e = self._starts[a:b], self._ends[a:b]
-        rows = np.flatnonzero(e > s)
+        s, e, rows = self._select_rows(a, b)
         if rows.size == 0:
             return
         ls, le = s[rows], e[rows]
@@ -485,8 +525,7 @@ class SamScanParser(_OverlapScanParser):
     line_parser = _line.SamParser
 
     def _parse_lines(self, dst: List[Overlap], a: int, b: int) -> None:
-        s, e = self._starts[a:b], self._ends[a:b]
-        rows = np.flatnonzero(e > s)
+        s, e, rows = self._select_rows(a, b)
         if rows.size == 0:
             return
         ls, le = s[rows], e[rows]

@@ -28,6 +28,13 @@ killing a backend under the router):
   journaled on its backend, merged reply not yet assembled (r20);
   a restarted router re-plans the same shards and the backend
   journals answer every one as a duplicate
+* ``route-mid-rebalance`` — straggler detected and the rebalance
+  decision recorded, replacement attempt not yet launched and the
+  original not yet canceled (r21); a restarted router re-plans the
+  same shards under the ORIGINAL keys, so completed shards dedup at
+  their journals and the straggling shard simply re-runs — the
+  half-made rebalance leaves no orphan state because the ``-r<n>``
+  key was never submitted anywhere
 
 Counting is per-process and lock-guarded, so ``<site>:<nth>`` is
 deterministic under concurrent workers.  An unarmed site costs one
@@ -44,7 +51,8 @@ import threading
 
 SITES = ("post-admit", "mid-megabatch", "pre-demux",
          "pre-done-record", "journal-write",
-         "route-pre-forward", "route-pre-reply", "route-mid-gather")
+         "route-pre-forward", "route-pre-reply", "route-mid-gather",
+         "route-mid-rebalance")
 
 _lock = threading.Lock()
 _counts: dict = {}

@@ -53,6 +53,7 @@ KNOWN_KNOBS = {
     "RACON_TPU_BP_COLS": "4000000",
     "RACON_TPU_POA_HOST_RESERVE": "0.25",
     "RACON_TPU_CACHE_DIR": "",
+    "RACON_TPU_XLA_CACHE_DIR": "",
     "RACON_TPU_TRACE": "",
     "RACON_TPU_METRICS_JSON": "",
     # serving (racon_tpu/serve): queue bound, worker count, idle
@@ -132,6 +133,12 @@ KNOWN_KNOBS = {
     # from the engine epoch.
     "RACON_TPU_SCATTER_MIN_WALL_S": "",
     "RACON_TPU_SCATTER_MAX_SHARDS": "8",
+    # r21 shard-aware staging + straggler rebalancing: staged parsing
+    # is pinned byte-identical to the full parse (RACON_TPU_STAGE=0
+    # is the escape hatch), and the rebalance factor only moves WHERE
+    # a shard runs — both epoch-excluded like every placement knob.
+    "RACON_TPU_STAGE": "1",
+    "RACON_TPU_SCATTER_REBALANCE": "2.5",
 }
 
 # host-capability probe reference wall (bench.py's budget scaling):

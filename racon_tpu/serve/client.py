@@ -209,6 +209,17 @@ def health(socket_path: str, timeout: float = 30.0) -> dict:
     return request(socket_path, {"op": "health"}, timeout=timeout)
 
 
+def cancel(socket_path: str, job_key: str,
+           timeout: float = 30.0) -> dict:
+    """Best-effort job cancellation by idempotence key (r21: the
+    router's straggler rebalancer sends this to a superseded shard's
+    backend).  A queued job finishes as ``job_canceled`` without
+    running; a running one stops at its next between-units poll site;
+    unknown/finished keys are a safe no-op."""
+    return request(socket_path, {"op": "cancel", "job_key": job_key},
+                   timeout=timeout)
+
+
 def route_status(socket_path: str, timeout: float = 30.0) -> dict:
     """Router-detail document (the r19 ``route_status`` op): per
     backend breaker state / probe staleness / queue depth, plus the

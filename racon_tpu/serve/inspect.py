@@ -104,6 +104,24 @@ def _detail(ev: dict) -> str:
                 f"completed={ev.get('completed', 0)} "
                 f"requeued={ev.get('requeued', 0)} "
                 f"failed={ev.get('failed', 0)}")
+    # r21 straggler rebalancing: the router's handoff, the daemon's
+    # cancel acknowledgement, and the yielding job's terminal event
+    if kind == "route_rebalance":
+        return (f"shard={ev.get('shard', '?')} "
+                f"r{ev.get('attempt', '?')} -> "
+                f"{ev.get('backend', '?')} "
+                f"elapsed={ev.get('elapsed_s', '?')}s "
+                f"threshold={ev.get('threshold_s', '?')}s")
+    if kind == "route_stage_plan":
+        staged = ev.get("staged_bytes") or []
+        return (f"shards={ev.get('shards', '?')} "
+                f"staged_bytes={'/'.join(str(b) for b in staged)} "
+                f"of {ev.get('total_bytes', '?')}")
+    if kind == "cancel":
+        return (f"job_key={ev.get('job_key', '?')} "
+                f"state={ev.get('state', '?')}")
+    if kind == "job_canceled":
+        return "yielded to a rebalanced attempt"
     return ""
 
 

@@ -186,6 +186,42 @@ Scatter/gather mega-job sharding (r20, racon_tpu/serve/scatter.py):
   ``route_scatter_jobs``/``route_scatter_shards``/
   ``route_cache_affinity`` counters; a router's ``health`` doc
   carries ``scatter: true`` as the capability flag wrappers key off.
+
+Staged inputs + straggler rebalancing (r21, racon_tpu/io/staging.py
++ the router watchdog):
+
+* Sub-job specs may carry ``spec["stage"]`` — the router's slice
+  hint for the shard's overlaps file: ``{"ranges": [[start, end),
+  ...], "sig": [size, newline-count], "shard": [i, k],
+  "staged_bytes": N, "total_bytes": M}``.  The daemon validates the
+  signature and shard coordinates against the file it opens and
+  restricts the overlap scan to the byte ranges (the record stream
+  for owned targets is byte-identical to the full parse); ANY
+  mismatch, malformed hint, or ``RACON_TPU_STAGE=0`` falls back to
+  the full parse — staging is policy, never bytes.  The job report's
+  ``host.staged_bytes`` / ``host.parse_skipped_bytes`` gauges
+  account for the skip.
+* ``cancel`` op: ``{"op": "cancel", "job_key": K}`` — best-effort
+  cancellation by idempotence key.  A queued job finishes as the
+  error code ``job_canceled`` without running; a running one stops
+  at its next between-units poll site (after its last committed
+  checkpoint); unknown or finished keys are a safe no-op (the reply
+  carries ``state`` saying which).  The router's rebalancer
+  broadcasts this for superseded attempt keys.
+* A straggling shard (elapsed beyond ``max(factor x p50 predicted
+  shard wall, 4 probe periods)``, factor from
+  ``RACON_TPU_SCATTER_REBALANCE``) gets a speculative replacement
+  under the derived key ``<job_key>-shard-<i>of<k>-r<n>`` on the
+  idlest untried backend; first success wins the shard, losers are
+  canceled.  The merged response's ``scatter`` block adds
+  ``staged_bytes`` and ``rebalanced`` (per-shard lineage strings,
+  e.g. ``"0of2-r1 <- 0of2"``); ``route_status``'s
+  ``scatter.active`` rows add per-shard ``staged_bytes`` /
+  ``parse_skipped_bytes`` / ``rebalanced``, its ``scatter`` block
+  reports ``rebalance_factor`` and ``staging``, and the
+  ``route_stage_plans`` / ``route_rebalance`` / ``route_cancels``
+  counters plus ``route_stage_plan`` / ``route_rebalance`` flight
+  events make every plan and handoff auditable.
 """
 
 from __future__ import annotations

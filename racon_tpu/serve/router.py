@@ -58,6 +58,30 @@ fault-tolerance layer of the ROADMAP's fleet-scale serving item.
   ``target_slice`` contract — and answers the client with one merged
   frame whose report carries per-shard sub-blocks.  Shard progress is
   visible in ``route_status`` while a scatter is live.
+* **Shard-aware input staging (r21)** — at scatter plan time the
+  router builds a one-pass slice index over the overlaps file
+  (racon_tpu/io/staging.py) and ships each sub-job a ``stage`` hint:
+  the line ranges of the whole query-runs that can contribute rows to
+  that shard's targets.  The receiving daemon validates the hint
+  (path + file signature + shard coordinates) and parses only those
+  ranges — byte-identical to the full parse for owned targets —
+  instead of parsing everything and dropping (K-1)/K of it.
+  ``RACON_TPU_STAGE=0`` restores the full parse everywhere; planning
+  failures (non-PAF input, malformed rows, remote paths) silently
+  fall back to unhinted sub-jobs.
+* **Cross-shard straggler rebalancing (r21)** — the probe loop
+  watches live scatters; a shard whose current attempt has run past
+  ``RACON_TPU_SCATTER_REBALANCE x p50`` of the plan's predicted shard
+  walls (and at least four probe periods) gets a speculative
+  replacement attempt under the derived key
+  ``<job_key>-shard-<i>of<k>-r<n>`` on the idlest eligible backend it
+  has not tried, while the superseded attempt is asked to
+  cancel-after-checkpoint (the ``cancel`` op; daemons stop at their
+  next poll site, keeping everything journaled).  First successful
+  attempt wins the shard slot, so the gather's bytes are those of the
+  unsharded run no matter which attempt delivered them; the
+  ``route-mid-rebalance`` fault site pins exactly-once across a
+  router death in the middle of the handoff.
 * **Cache-affinity tiebreak** — when predicted walls tie within 10%,
   placement prefers the backend whose result cache (r14/r18) reports
   the higher hit ratio — and, among those, one that recently served
@@ -92,6 +116,10 @@ Knobs (all placement policy — none can change job bytes, so all are
 * ``RACON_TPU_SCATTER_MIN_WALL_S``       auto-scatter threshold,
   "" = only explicit ``--shards`` scatters
 * ``RACON_TPU_SCATTER_MAX_SHARDS``       shard-count cap (8)
+* ``RACON_TPU_SCATTER_REBALANCE``        straggler factor (2.5,
+  0 = rebalancing off)
+* ``RACON_TPU_STAGE``                    staged inputs (1; 0 = full
+  parse — the one staging knob, byte-identical either way)
 """
 
 from __future__ import annotations
@@ -159,6 +187,11 @@ _MAX_ROUNDS = 3
 
 #: cap on the inter-round spillover sleep
 _MAX_ROUND_WAIT_S = 10.0
+
+#: cap on speculative rebalance attempts per shard (r1, r2): a shard
+#: slow on its THIRD backend is telling us about the job, not the
+#: placement, and further copies only burn fleet capacity
+_REBALANCE_MAX_ATTEMPTS = 2
 
 
 class Backend:
@@ -384,6 +417,13 @@ class FleetRouter:
     def _probe_loop(self) -> None:
         while not self._stop.wait(self.probe_interval):
             self._probe_round()
+            # r21: the same cadence that refreshes backend health
+            # watches live scatters for straggling shards
+            try:
+                self._rebalance_round()
+            except Exception as exc:   # a watchdog bug must never
+                obs_flight.FLIGHT.record_exception(  # kill probing
+                    "route_rebalance_error", exc)
 
     # -- placement -----------------------------------------------------
 
@@ -587,6 +627,37 @@ class FleetRouter:
             return self._route_job(spec, req, job_key)
         return self._scatter_job(spec, req, job_key, k)
 
+    def _plan_stage(self, spec: dict, k: int) -> dict:
+        """r21 staged inputs: build the overlaps slice index ONCE at
+        plan time (racon_tpu/io/staging.py) and derive each shard's
+        ``stage`` hint from it, so the K daemons skip the (K-1)/K of
+        the overlap parse their ownership mask would drop anyway.
+        Strictly best-effort: any failure (non-PAF input, malformed
+        rows, unreadable targets, a TCP-remote client naming paths
+        this host cannot read) returns no hints and every shard
+        self-plans or full-parses — a hint can speed a shard up,
+        never fail it.  The receiving daemons re-validate path + file
+        signature + shard coordinates before trusting a hint."""
+        from racon_tpu.io import staging
+        if not staging.stage_enabled():
+            return {}
+        try:
+            names = staging.fasta_names(spec["targets"])
+            index = staging.get_index(spec["overlaps"], names)
+            if index is None:
+                return {}
+            hints = {i: staging.shard_hint(index, (i, k), len(names))
+                     for i in range(k)}
+        except Exception:
+            return {}
+        REGISTRY.add("route_stage_plans")
+        obs_flight.FLIGHT.record(
+            "route_stage_plan", shards=k,
+            total_bytes=hints[0].get("total_bytes"),
+            staged_bytes=[hints[i].get("staged_bytes")
+                          for i in range(k)])
+        return hints
+
     def _scatter_job(self, spec: dict, req: dict, job_key: str,
                      k: int) -> dict:
         """Fan a mega-job out as K target-sharded sub-jobs and gather
@@ -614,7 +685,17 @@ class FleetRouter:
         full, and a re-run on a different survivor still returns the
         same bytes (the target_slice contract) — exactly-once decays
         to at-least-once only when the fleet itself changed between
-        duplicates."""
+        duplicates.
+
+        r21: each shard runs as a SLOT holding one or more attempts.
+        The original attempt runs under the shard key; the probe
+        loop's watchdog (:meth:`_rebalance_scan`) may add speculative
+        replacement attempts under derived ``-r<n>`` keys when the
+        shard straggles.  First successful attempt wins the slot —
+        the gather concatenates winners in target order, so the bytes
+        are those of the unsharded run regardless of which attempt
+        delivered them — and a superseded attempt's ``job_canceled``
+        reply never fails the shard."""
         t0 = obs_trace.now()
         REGISTRY.add("route_scatter_jobs")
         REGISTRY.add("route_scatter_shards", k)
@@ -622,54 +703,131 @@ class FleetRouter:
         eligible = [b.target for b in self.backends if b.eligible()]
         prefer = {i: eligible[i % len(eligible)]
                   for i in range(k)} if eligible else {}
+        stage_hints = self._plan_stage(spec, k)
+        # the plan's per-shard predicted walls: the p50 is the
+        # straggler watchdog's yardstick for "this shard is late"
+        predicted = []
+        for i in range(k):
+            est = self._price(
+                scatter.shard_spec(spec, i, k,
+                                   stage=stage_hints.get(i)), 1)
+            predicted.append(est.get("predicted_wall_s")
+                             if est else None)
+        walls = sorted(w for w in predicted if w is not None)
+        p50 = walls[len(walls) // 2] if walls else None
+        slots = []
+        for i in range(k):
+            hint = stage_hints.get(i) or {}
+            staged = hint.get("staged_bytes")
+            total = hint.get("total_bytes")
+            slots.append({
+                "shard": i, "done": threading.Event(),
+                "finished": False, "result": None,
+                "winner_key": None, "errors": [], "keys": [],
+                "pending": 0, "rebalances": 0,
+                "backends": set(), "started": None, "lineage": None,
+                "staged_bytes": staged,
+                "parse_skipped_bytes": (
+                    total - staged
+                    if staged is not None and total else None),
+            })
         progress = {"job_key": job_key, "shards": k, "done": 0,
-                    "backends": [None] * k}
+                    "backends": [None] * k, "p50_wall_s": p50,
+                    "slots": slots}
+
+        def settle(i: int, key: str, resp: dict) -> None:
+            slot = slots[i]
+            cancel_keys, finished = None, False
+            with self._lock:
+                slot["pending"] -= 1
+                if resp.get("ok") and slot["result"] is None:
+                    slot["result"] = resp
+                    slot["winner_key"] = key
+                    progress["backends"][i] = \
+                        resp.get("routed_backend")
+                    if resp.get("routed_backend"):
+                        # per-attempt sticky: a later duplicate of
+                        # this key routes straight back to the
+                        # journal that recorded it, even if failover
+                        # moved the attempt off its preferred backend
+                        self._done_backend[key] = \
+                            resp["routed_backend"]
+                    cancel_keys = [x for x in slot["keys"]
+                                   if x != key]
+                elif not resp.get("ok"):
+                    slot["errors"].append((key, dict(resp)))
+                if (slot["result"] is not None
+                        or slot["pending"] == 0) \
+                        and not slot["finished"]:
+                    slot["finished"] = True
+                    progress["done"] += 1
+                    finished = True
+            obs_flight.FLIGHT.record(
+                "route_scatter_shard", job_key=job_key, shard=i,
+                key=key, ok=bool(resp.get("ok")),
+                backend=resp.get("routed_backend"),
+                wall_s=resp.get("wall_s"))
+            if cancel_keys:
+                # a superseded sibling may still be running its
+                # copy: cancel-after-checkpoint, fire-and-forget
+                self._broadcast_cancel(cancel_keys)
+            if finished:
+                slot["done"].set()
+
+        def run_attempt(i: int, key: str, pref) -> None:
+            resp = self._route_job(
+                scatter.shard_spec(spec, i, k,
+                                   stage=stage_hints.get(i)),
+                req, key, prefer=pref)
+            settle(i, key, resp)
+
+        def launch(i: int, key: str, pref) -> None:
+            slot = slots[i]
+            with self._lock:
+                slot["pending"] += 1
+                slot["keys"].append(key)
+                slot["started"] = obs_trace.now()
+                if pref:
+                    slot["backends"].add(pref)
+            threading.Thread(
+                target=run_attempt, args=(i, key, pref),
+                daemon=True,
+                name=f"racon-route-shard-{i}").start()
+
+        # the watchdog launches replacement attempts through the
+        # same path the originals take
+        progress["launch"] = launch
         with self._lock:
             self._scatter_live[job_key] = progress
         obs_flight.FLIGHT.record(
             "route_scatter", job_key=job_key, shards=k,
-            tenant=spec.get("tenant"))
+            staged=bool(stage_hints), tenant=spec.get("tenant"))
         eprint(f"[racon_tpu::route] scatter: job {job_key} -> {k} "
-               f"target shard(s)")
-        results = [None] * k
-
-        def run_shard(i: int) -> None:
-            resp = self._route_job(scatter.shard_spec(spec, i, k),
-                                   req, keys[i],
-                                   prefer=prefer.get(i))
-            results[i] = resp
-            with self._lock:
-                progress["done"] += 1
-                progress["backends"][i] = resp.get("routed_backend")
-                if resp.get("ok") and resp.get("routed_backend"):
-                    # per-shard sticky: a later duplicate of this
-                    # mega-job routes each shard straight back to
-                    # the journal that recorded it, even if failover
-                    # moved the shard off its preferred backend
-                    self._done_backend[keys[i]] = \
-                        resp["routed_backend"]
-            obs_flight.FLIGHT.record(
-                "route_scatter_shard", job_key=job_key, shard=i,
-                ok=bool(resp.get("ok")),
-                backend=resp.get("routed_backend"),
-                wall_s=resp.get("wall_s"))
-
-        threads = [threading.Thread(target=run_shard, args=(i,),
-                                    daemon=True,
-                                    name=f"racon-route-shard-{i}")
-                   for i in range(k)]
+               f"target shard(s)"
+               + (" (staged inputs)" if stage_hints else ""))
         try:
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
+            for i in range(k):
+                launch(i, keys[i], prefer.get(i))
+            for slot in slots:
+                slot["done"].wait()
             faultinject.hit("route-mid-gather")
-            for i, resp in enumerate(results):
-                if resp is not None and resp.get("ok"):
+            results, win_keys = [], []
+            for i, slot in enumerate(slots):
+                if slot["result"] is not None:
+                    results.append(slot["result"])
+                    win_keys.append(slot["winner_key"])
                     continue
-                # surface the first failed shard; completed siblings
-                # are journaled on their backends, so the client's
-                # retry under the same key re-runs ONLY the failures
+                # surface the shard's first REAL failure; completed
+                # siblings are journaled on their backends, so the
+                # client's retry under the same key re-runs ONLY the
+                # failures.  A superseded attempt's job_canceled
+                # never speaks for the shard
+                resp = next(
+                    (r for _, r in slot["errors"]
+                     if (r.get("error") or {}).get("code")
+                     != "job_canceled"),
+                    slot["errors"][-1][1] if slot["errors"]
+                    else None)
                 REGISTRY.add("route_scatter_failed")
                 err = dict((resp or {}).get("error")
                            or {"code": "job_failed",
@@ -678,11 +836,14 @@ class FleetRouter:
                 err["shard"] = i
                 err["shards"] = k
                 return {"ok": False, "error": err}
-            out = scatter.merge_responses(results, keys)
+            out = scatter.merge_responses(results, win_keys)
             wall = obs_trace.now() - t0
             out["wall_s"] = round(wall, 6)
-            out["scatter"] = {"shards": k,
-                              "backends": list(progress["backends"])}
+            out["scatter"] = {
+                "shards": k,
+                "backends": list(progress["backends"]),
+                "staged_bytes": [s["staged_bytes"] for s in slots],
+                "rebalanced": [s["lineage"] for s in slots]}
             obs_flight.FLIGHT.record(
                 "route_gather", job_key=job_key, shards=k,
                 wall_s=round(wall, 6),
@@ -691,6 +852,115 @@ class FleetRouter:
         finally:
             with self._lock:
                 self._scatter_live.pop(job_key, None)
+
+    # -- straggler rebalancing (r21) -----------------------------------
+
+    def _idlest_backend(self, exclude=()):
+        """The eligible backend with the lowest live load (probed
+        depth + this router's in-flight placements), CLI order as
+        the tiebreak — where a straggler's replacement attempt
+        goes."""
+        with self._lock:
+            placing = dict(self._placing)
+        best = None
+        for idx, backend in enumerate(self.backends):
+            if backend.target in exclude or not backend.eligible():
+                continue
+            rank = (backend.load()
+                    + placing.get(backend.target, 0), idx)
+            if best is None or rank < best[0]:
+                best = (rank, backend.target)
+        return best[1] if best else None
+
+    def _broadcast_cancel(self, keys) -> None:
+        """Best-effort cancel of superseded attempt keys on every
+        backend (failover may have moved an attempt anywhere, and a
+        cancel for a key a backend never saw is a cheap no-op).
+        Runs detached: the daemon stops the job at its next poll
+        site AFTER the last committed checkpoint; nothing here
+        blocks routing or gathering."""
+        targets = [b.target for b in self.backends]
+        timeout = self.probe_timeout
+
+        def worker() -> None:
+            for key in keys:
+                REGISTRY.add("route_cancels")
+                for target in targets:
+                    try:
+                        client.cancel(target, key, timeout=timeout)
+                    except Exception:
+                        pass
+
+        threading.Thread(target=worker, daemon=True,
+                         name="racon-route-cancel").start()
+
+    def _rebalance_round(self) -> None:
+        factor = scatter.rebalance_factor()
+        if factor is None:
+            return
+        now = obs_trace.now()
+        with self._lock:
+            live = list(self._scatter_live.values())
+        for prog in live:
+            self._rebalance_scan(prog, factor, now)
+
+    def _rebalance_scan(self, prog: dict, factor: float,
+                        now: float) -> None:
+        """One watchdog pass over a live scatter: any unfinished
+        shard whose CURRENT attempt has run past ``max(factor x
+        p50(predicted shard walls), 4 probe periods)`` gets a
+        speculative replacement on the idlest eligible backend the
+        shard has not yet tried, under a derived ``-r<n>`` key
+        (scatter.rebalance_key) so the replacement is its own
+        exactly-once unit at its backend's journal.  First success
+        wins the slot; the superseded attempts are
+        cancel-after-checkpoint'd.  The floor of four probe periods
+        keeps a fast plan from tripping on probe jitter; launching
+        an attempt resets the shard's clock, so a second rebalance
+        needs the replacement to straggle too."""
+        k = prog["shards"]
+        threshold = max(
+            factor * float(prog.get("p50_wall_s") or 0.0),
+            4.0 * self.probe_interval)
+        for slot in prog.get("slots", ()):
+            with self._lock:
+                started = slot["started"]
+                if slot["finished"] or started is None \
+                        or now - started <= threshold \
+                        or slot["rebalances"] \
+                        >= _REBALANCE_MAX_ATTEMPTS:
+                    continue
+                exclude = set(slot["backends"])
+                superseded = list(slot["keys"])
+            target = self._idlest_backend(exclude)
+            if target is None:
+                continue    # nowhere better to run a copy
+            with self._lock:
+                if slot["finished"] or slot["rebalances"] \
+                        >= _REBALANCE_MAX_ATTEMPTS:
+                    continue
+                slot["rebalances"] += 1
+                attempt = slot["rebalances"]
+                i = slot["shard"]
+                slot["lineage"] = f"{i}of{k}-r{attempt} <- {i}of{k}"
+            key = scatter.rebalance_key(prog["job_key"], i, k,
+                                        attempt)
+            REGISTRY.add("route_rebalance")
+            obs_flight.FLIGHT.record(
+                "route_rebalance", job_key=prog["job_key"],
+                shard=i, attempt=attempt, key=key, backend=target,
+                elapsed_s=round(now - started, 3),
+                threshold_s=round(threshold, 3))
+            eprint(f"[racon_tpu::route] rebalance: shard {i}of{k} "
+                   f"of job {prog['job_key']} straggling "
+                   f"({now - started:.1f}s > {threshold:.1f}s); "
+                   f"speculative attempt r{attempt} -> {target}")
+            faultinject.hit("route-mid-rebalance")
+            prog["launch"](i, key, target)
+            # cancel-after-checkpoint on the superseded original:
+            # it stops at its next poll site, keeping everything it
+            # already journaled
+            self._broadcast_cancel(superseded)
 
     def _route_job(self, spec: dict, req: dict, job_key: str,
                    prefer: str = None) -> dict:
@@ -820,6 +1090,7 @@ class FleetRouter:
         breaker + staleness rows, routing counters, listener
         addresses.  ``router: true`` is what clients key rendering
         off."""
+        from racon_tpu.io import staging
         now = obs_trace.now()
         stale_after = 3 * self.probe_interval + self.probe_timeout
         rows = []
@@ -836,7 +1107,14 @@ class FleetRouter:
             done_keys = len(self._done_backend)
             scatter_rows = [
                 {"job_key": p["job_key"], "shards": p["shards"],
-                 "done": p["done"], "backends": list(p["backends"])}
+                 "done": p["done"], "backends": list(p["backends"]),
+                 "staged_bytes": [s["staged_bytes"]
+                                  for s in p.get("slots", ())],
+                 "parse_skipped_bytes": [s["parse_skipped_bytes"]
+                                         for s in p.get("slots",
+                                                        ())],
+                 "rebalanced": [s["lineage"]
+                                for s in p.get("slots", ())]}
                 for p in self._scatter_live.values()]
         return {
             "ok": True,
@@ -853,7 +1131,10 @@ class FleetRouter:
             "backends": rows,
             "scatter": {"active": scatter_rows,
                         "min_wall_s": scatter.min_wall_s(),
-                        "max_shards": scatter.max_shards()},
+                        "max_shards": scatter.max_shards(),
+                        "rebalance_factor":
+                            scatter.rebalance_factor(),
+                        "staging": staging.stage_enabled()},
             "counters": counters,
         }
 

@@ -29,11 +29,22 @@ of the targets and the gather would return wrong bytes.  With ``k``
 in the key a re-planned duplicate simply re-runs fresh (at-least-once
 across plan changes, exactly-once within a plan).
 
-Knobs (provenance.KNOWN_KNOBS; both epoch-excluded):
+Rebalance keys (r21): a straggling shard's speculative replacement
+runs under ``K-shard-<i>of<k>-r<n>`` — deliberately DISTINCT from the
+original's key, so the replacement is a fresh exactly-once unit at
+its own backend's journal and can never be answered from the
+straggler's records.  First successful attempt wins the shard slot at
+the router; the superseded attempt is cancel-after-checkpoint'd and
+its ``job_canceled`` reply discarded.
+
+Knobs (provenance.KNOWN_KNOBS; all epoch-excluded):
 
 * ``RACON_TPU_SCATTER_MIN_WALL_S`` (default "" = off): predicted-wall
   threshold above which the router auto-scatters a submit.  An
   explicit ``--shards`` on the submit always wins.
+* ``RACON_TPU_SCATTER_REBALANCE`` (default 2.5; 0 = off): straggler
+  threshold factor for cross-shard rebalancing — see
+  :func:`rebalance_factor`.
 * ``RACON_TPU_SCATTER_MAX_SHARDS`` (default 8): cap on the planned
   shard count.  Auto/threshold plans are additionally capped by the
   number of eligible backends (a shard without a backend would just
@@ -60,6 +71,23 @@ def min_wall_s():
         value = float(raw)
     except ValueError:
         return None
+    return value if value > 0 else None
+
+
+def rebalance_factor():
+    """The straggler threshold factor for r21 cross-shard
+    rebalancing, or None when rebalancing is off.  A live shard whose
+    elapsed wall exceeds ``factor x p50(predicted shard walls)`` (and
+    at least four probe periods, so a fast plan never trips on probe
+    jitter) gets a speculative replacement attempt under a derived
+    ``-r<n>`` key.  Default 2.5; ``0`` (or any non-positive value)
+    disables, unparsable input falls back to the default — placement
+    policy only, epoch-excluded like every other scatter knob."""
+    raw = os.environ.get("RACON_TPU_SCATTER_REBALANCE", "")
+    try:
+        value = float(raw or "2.5")
+    except ValueError:
+        value = 2.5
     return value if value > 0 else None
 
 
@@ -146,11 +174,34 @@ def shard_key(job_key: str, index: int, count: int) -> str:
     return job_key + suffix
 
 
-def shard_spec(spec: dict, index: int, count: int) -> dict:
+def rebalance_key(job_key: str, index: int, count: int,
+                  attempt: int) -> str:
+    """The derived key for rebalance attempt ``n`` of shard ``i``:
+    ``<job_key>-shard-<i>of<k>-r<n>`` (r21 straggler rebalancing).
+    A DISTINCT key from the original's on purpose: the replacement
+    is a fresh exactly-once unit at its own backend's journal, so it
+    can never be answered from the straggler's records — first
+    successful attempt wins the shard slot at the router.  Same
+    length-folding rule as :func:`shard_key`, applied with the full
+    suffix so the derived key stays inside the 128-char contract."""
+    suffix = f"-shard-{index}of{count}-r{int(attempt)}"
+    if len(job_key) + len(suffix) > 128:
+        job_key = "sc-" + hashlib.sha256(
+            job_key.encode("utf-8")).hexdigest()[:32]
+    return job_key + suffix
+
+
+def shard_spec(spec: dict, index: int, count: int,
+               stage: dict = None) -> dict:
     """Shard ``index``'s sub-job spec: the mega-job's spec (tenant,
-    inputs, options all inherited) plus the target shard."""
+    inputs, options all inherited) plus the target shard and, when
+    the router built a slice index at plan time, the shard's staged
+    -input hint (r21; the receiving daemon validates it against its
+    own view of the file before trusting it)."""
     sub = dict(spec)
     sub["shard"] = [int(index), int(count)]
+    if stage is not None:
+        sub["stage"] = stage
     return sub
 
 

@@ -127,9 +127,26 @@ def estimate_job(spec: dict, concurrency: int = 1) -> dict:
     poa_mbps = float(os.environ.get("RACON_TPU_SERVE_POA_MBPS",
                                     _POA_MB_PER_S))
     mb = 1024.0 * 1024.0
+    # r21 staged shards: a sub-job carrying a stage hint parses only
+    # its slice of the overlaps, so the parse/align term prices the
+    # STAGED byte fraction, not the full file — before this, scatter
+    # thresholds and placement overestimated every shard's wall by
+    # the redundant (K-1)/K parse it no longer does
+    overlap_bytes = sizes["overlaps"]
+    staged_fraction = None
+    stage = spec.get("stage")
+    if isinstance(stage, dict):
+        try:
+            sb = int(stage.get("staged_bytes", 0))
+            tb = int(stage.get("total_bytes", 0))
+        except (TypeError, ValueError):
+            sb = tb = 0
+        if tb > 0 and 0 <= sb <= tb:
+            staged_fraction = sb / tb
+            overlap_bytes = sizes["overlaps"] * staged_fraction
     # align work scales with the read+overlap volume, POA with the
     # read volume layered over the targets
-    align_s = (sizes["sequences"] + sizes["overlaps"]) / mb / align_mbps
+    align_s = (sizes["sequences"] + overlap_bytes) / mb / align_mbps
     poa_s = (sizes["sequences"] + sizes["targets"]) / mb / poa_mbps
     est = calibrate.predict_walls(align_s, poa_s,
                                   overlap_s=min(align_s, poa_s),
@@ -137,6 +154,10 @@ def estimate_job(spec: dict, concurrency: int = 1) -> dict:
                                   occupancy=_mean_fusion_occupancy(),
                                   hit_ratio=_observed_hit_ratio())
     est["input_bytes"] = sizes
+    if staged_fraction is not None:
+        est["staged_fraction"] = round(staged_fraction, 6)
+        est["input_bytes"] = dict(sizes)
+        est["input_bytes"]["overlaps_staged"] = int(overlap_bytes)
     return est
 
 
@@ -173,6 +194,10 @@ class Job:
         self.t_submit: Optional[float] = None   # admission timestamp
         self.done = threading.Event()
         self.result: Optional[dict] = None   # set exactly once
+        # r21 rebalancing: set by JobScheduler.cancel(); a queued job
+        # finishes as job_canceled without running, a running one
+        # stops at the polisher's next between-units poll site
+        self.cancel_requested = threading.Event()
 
     def finish(self, result: dict) -> None:
         self.result = result
@@ -360,6 +385,18 @@ class JobScheduler:
                     "code": "bad_request",
                     "reason": "shard must be [index, count] with "
                               "0 <= index < count <= 4096"})
+        # r21 staging: a routed sub-job may carry the router's slice
+        # index as spec["stage"].  Validate the shape at admission;
+        # the polisher re-validates content (path + file signature)
+        # and silently full-parses on mismatch, so only structurally
+        # broken hints are rejected here.
+        stage = spec.get("stage")
+        if stage is not None:
+            from racon_tpu.io import staging
+            stage_err = staging.validate_stage_field(stage)
+            if stage_err is not None:
+                raise RejectError({"code": "bad_request",
+                                   "reason": stage_err})
         # price against the load the job would actually share the
         # device with (approximate read outside the lock is fine --
         # admission only needs the right order of magnitude)
@@ -506,31 +543,44 @@ class JobScheduler:
                 self._journal_append("start", job=job.id,
                                      job_key=job.job_key,
                                      tenant=job.tenant)
-            # the job is a device-executor tenant for its lifetime:
-            # its megabatches fuse with other registered tenants',
-            # under the executor's DRR fairness + in-flight quota
-            from racon_tpu.tpu import executor as device_executor
+            if job.cancel_requested.is_set():
+                # r21: canceled while still queued — finish through
+                # the normal terminal path (journal record + dedup
+                # index + rendezvous) without ever running
+                result = {
+                    "ok": False,
+                    "error": {"code": "job_canceled",
+                              "reason": "job canceled before start "
+                                        "(superseded by a rebalanced "
+                                        "attempt)"}}
+            else:
+                # the job is a device-executor tenant for its
+                # lifetime: its megabatches fuse with other registered
+                # tenants', under the executor's DRR fairness +
+                # in-flight quota
+                from racon_tpu.tpu import executor as device_executor
 
-            ex = device_executor.get_executor()
-            ex.register_tenant(job.tenant,
-                               weight=max(1.0, 1.0 + job.priority))
-            # the job context makes everything recorded during this
-            # job's execution — spans, flight events, log lines —
-            # attributable to (job, tenant) with no call-site plumbing
-            with obs_context.job_context(job.id, job.tenant,
-                                         trace_id=job.trace_id):
-                try:
-                    result = self._runner(job)
-                except Exception as exc:  # runner bug: job fails,
-                    obs_flight.FLIGHT.record_exception(  # server and
-                        "error", exc)                    # queue survive
-                    result = {
-                        "ok": False,
-                        "error": {"code": "job_failed",
-                                  "type": type(exc).__name__,
-                                  "reason": str(exc)}}
-                finally:
-                    ex.release_tenant(job.tenant)
+                ex = device_executor.get_executor()
+                ex.register_tenant(job.tenant,
+                                   weight=max(1.0, 1.0 + job.priority))
+                # the job context makes everything recorded during
+                # this job's execution — spans, flight events, log
+                # lines — attributable to (job, tenant) with no
+                # call-site plumbing
+                with obs_context.job_context(job.id, job.tenant,
+                                             trace_id=job.trace_id):
+                    try:
+                        result = self._runner(job)
+                    except Exception as exc:  # runner bug: job fails,
+                        obs_flight.FLIGHT.record_exception(  # server
+                            "error", exc)          # and queue survive
+                        result = {
+                            "ok": False,
+                            "error": {"code": "job_failed",
+                                      "type": type(exc).__name__,
+                                      "reason": str(exc)}}
+                    finally:
+                        ex.release_tenant(job.tenant)
             t_done = obs_trace.now()
             exec_wall = t_done - t_pop
             obs_trace.TRACER.add_span(
@@ -586,6 +636,33 @@ class JobScheduler:
                 REGISTRY.set("serve_running", len(self._running))
                 self._cond.notify_all()
             job.finish(result)
+
+    # -- cancellation (r21) --------------------------------------------
+
+    def cancel(self, job_key: str) -> dict:
+        """Best-effort cancel by idempotence key (the router's
+        straggler rebalancer sends this to a superseded original).
+        A queued job finishes as ``job_canceled`` without running; a
+        running one stops at the polisher's next between-units poll
+        site — cancel-after-checkpoint, so everything it journaled
+        stays replayable.  Unknown/finished keys are a no-op: cancel
+        can always be sent safely."""
+        with self._cond:
+            job = self._by_key.get(job_key)
+            if job is None:
+                state = ("finished"
+                         if job_key in self._completed_by_key
+                         else "unknown")
+                return {"ok": True, "job_key": job_key,
+                        "state": state}
+            job.cancel_requested.set()
+            state = ("running" if job.id in self._running
+                     else "queued")
+        REGISTRY.add("serve_cancel_requests")
+        obs_flight.FLIGHT.record("cancel", job=job.id,
+                                 job_key=job_key, state=state,
+                                 trace_id=job.trace_id)
+        return {"ok": True, "job_key": job_key, "state": state}
 
     # -- lifecycle -----------------------------------------------------
 

@@ -386,6 +386,13 @@ class PolishServer:
                 resp = self._flight_doc(req)
             elif op == "explain":
                 resp = self._explain_doc(req)
+            elif op == "cancel":
+                key = req.get("job_key")
+                if not isinstance(key, str) or not key:
+                    resp = protocol.error_frame(
+                        "bad_request", "cancel carries no job_key")
+                else:
+                    resp = self.scheduler.cancel(key)
             elif op == "pause":
                 self.scheduler.pause()
                 resp = {"ok": True, "paused": True}

@@ -133,7 +133,8 @@ def _wire_durability(polisher, job) -> None:
 
 def run_job(job) -> dict:
     """Execute one admitted job; returns the response frame body."""
-    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.core.polisher import (JobCanceledError,
+                                         PolisherType, create_polisher)
     from racon_tpu.obs import provenance
 
     spec = job.spec
@@ -161,11 +162,40 @@ def run_job(job) -> dict:
             shard = _shard_of(spec)
             if shard is not None:
                 polisher._target_shard = shard
+                # r21 staged inputs: the router's plan-time slice
+                # index rides the sub-job spec; the polisher
+                # validates it (path + file signature + shard) and
+                # self-builds or full-parses on any mismatch
+                if isinstance(spec.get("stage"), dict):
+                    polisher._stage_hint = spec["stage"]
+            # r21 rebalancing: the scheduler's cancel flag (set by
+            # the router's `cancel` op when a replacement attempt
+            # superseded this shard) is polled between committed
+            # units — cancel-after-checkpoint by construction
+            cancel = getattr(job, "cancel_requested", None)
+            if cancel is not None:
+                polisher._cancel_check = cancel.is_set
             _wire_durability(polisher, job)
             polisher.initialize()
             polished = polisher.polish(opts["drop_unpolished"])
         fasta = b"".join(b">" + s.name.encode() + b"\n" + s.data
                          + b"\n" for s in polished)
+    except JobCanceledError:
+        # r21: a superseded straggler stopping at its poll site.
+        # Distinct from job_failed so the router's gather can tell
+        # "this shard yielded to its replacement" from a real error;
+        # everything checkpointed before the stop stays journaled.
+        if polisher is not None:
+            polisher.close()
+        REGISTRY.add("serve_jobs_canceled")
+        obs_flight.FLIGHT.record("job_canceled", job=job.id,
+                                 tenant=job.tenant,
+                                 trace_id=job.trace_id)
+        return {"ok": False,
+                "error": {"code": "job_canceled",
+                          "reason": "job canceled by the serve tier "
+                                    "(superseded by a rebalanced "
+                                    "attempt)"}}
     except Exception as exc:
         # containment boundary: InvalidInputError / parser errors are
         # the expected bad-job shapes, but ANY failure must release

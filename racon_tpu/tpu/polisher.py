@@ -1012,10 +1012,16 @@ class TPUPolisher(Polisher):
                 # between commit and journal merely replays one
                 # megabatch — never resumes uncommitted state.
                 self._checkpoint_cb(ckpt)
+            # r21 cancel-after-checkpoint: a superseded straggler
+            # stops HERE, right after its megabatch committed and
+            # journaled, so every window it checkpointed stays
+            # replayable and nothing half-applied is abandoned
+            self._poll_cancel()
             self.logger.bar("[racon_tpu::TPUPolisher::polish] "
                             "generating consensus (device)")
 
         while True:
+            self._poll_cancel()
             with lock:
                 limit = len(work) if steal else min(len(work),
                                                     dev_left)

@@ -8,7 +8,12 @@ across processes/runs the same way (first run pays, every later run --
 including every bench invocation -- loads from disk).
 
 Override the location with RACON_TPU_CACHE_DIR; set it empty to
-disable.
+disable.  RACON_TPU_XLA_CACHE_DIR overrides the XLA cache directory
+ALONE (empty = XLA cache off), without moving the result cache, the
+AOT shelf or calibration: a fleet of daemons with isolated result
+caches — or a test harness sandboxing RACON_TPU_CACHE_DIR per case —
+can still share one warm kernel cache, because compiled executables
+are keyed by HLO + compile options and can never change bytes.
 """
 
 from __future__ import annotations
@@ -37,10 +42,16 @@ def enable_compilation_cache() -> None:
     if _enabled:
         return
     _enabled = True
-    root = cache_root()
-    if root is None:  # HOME unset -> literal "~", or explicit empty
-        return
-    path = os.path.join(root, "xla")
+    override = os.environ.get("RACON_TPU_XLA_CACHE_DIR")
+    if override is not None:
+        if not override:
+            return
+        path = override
+    else:
+        root = cache_root()
+        if root is None:  # HOME unset -> literal "~", or explicit
+            return        # empty
+        path = os.path.join(root, "xla")
     import jax
 
     try:

@@ -25,6 +25,19 @@ os.environ.setdefault("RACON_TPU_RATE_ALIGN_CPU", "4.0")
 os.environ.setdefault("RACON_TPU_RATE_ALIGN_WFA_DEV", "700")
 os.environ.setdefault("RACON_TPU_RATE_ALIGN_WFA_CPU", "1.0")
 
+# one SHARED persistent XLA kernel cache for the whole suite,
+# inherited by every daemon/CLI subprocess the tests spawn: fixtures
+# sandbox RACON_TPU_CACHE_DIR (result cache, AOT shelf, calibration)
+# per module, which used to drag the XLA cache into the sandbox too —
+# every subprocess recompiled every kernel cold.  Compiled executables
+# are keyed by HLO + compile options, so sharing them can never change
+# bytes; it only removes duplicate compiles (hundreds of wall seconds
+# across the suite).
+os.environ.setdefault(
+    "RACON_TPU_XLA_CACHE_DIR",
+    os.path.join(os.path.expanduser("~"), ".cache", "racon_tpu",
+                 "xla"))
+
 if os.environ.get("RACON_TPU_TEST_PLATFORM", "cpu") == "cpu":
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")

@@ -323,3 +323,216 @@ def test_host_metrics_recorded(tmp_path, monkeypatch):
     assert m.value("host.stage_s") >= m.value("host.parse_s")
     assert 0.0 <= m.value("host.share") <= 1.0
     pol.close()
+
+# ---------------------------------------------------------------------------
+# r21 staged (ranged) scanning — the racon_tpu/io/staging.py contract.
+#
+# Reference trick: the staged parse of a file with set_stage(ranges)
+# must equal the FULL parse of a "masked twin" — the same file with
+# every out-of-range nonempty line replaced by an EMPTY line (keeping
+# the terminator, so line count and global line indices are
+# identical).  That pins the record stream AND malformed-row
+# diagnostics (same physical line numbers) byte-for-byte without
+# reimplementing the parser in the test.  Round counts are NOT
+# compared: the budget arithmetic deliberately keeps counting the raw
+# bytes of skipped rows, which the masked twin no longer has.
+
+_STAGE_ROW = b"q%d\t100\t5\t95\t+\tt%d\t200\t10\t190\t90\t100\t60"
+
+
+def _paf_lines(n, term=b"\n", blank_every=0, truncate_last=False):
+    lines = []
+    for i in range(n):
+        if blank_every and i % blank_every == blank_every - 1:
+            lines.append((b"", term))
+        else:
+            lines.append((_STAGE_ROW % (i, i % 3), term))
+    if truncate_last and lines:
+        lines[-1] = (lines[-1][0], b"")
+    return lines
+
+
+def _mask_lines(lines, ranges):
+    keep = set()
+    for lo, hi in ranges:
+        keep.update(range(lo, hi))
+    return [(body if i in keep else b"", term)
+            for i, (body, term) in enumerate(lines)]
+
+
+def _join(lines):
+    return b"".join(body + term for body, term in lines)
+
+
+def _drain_or_err(parser, budget=-1):
+    out = []
+    try:
+        while parser.parse(out, budget):
+            pass
+    except (ValueError, OverflowError) as exc:
+        return out, exc
+    return out, None
+
+
+def _staged_vs_masked(tmp_path, lines, ranges, cls=None, ext="paf",
+                      budgets=(-1,)):
+    """Staged parse of the original == full parse of the masked twin:
+    records, error type+text (modulo the file path), and — when the
+    parse completes — the skipped-bytes ledger."""
+    cls = cls or F.PafScanParser
+    orig = _write(tmp_path, f"orig.{ext}", _join(lines))
+    masked = _write(tmp_path, f"masked.{ext}",
+                    _join(_mask_lines(lines, ranges)))
+    mp = cls(masked)
+    want, want_exc = _drain_or_err(mp)
+    mp.close()
+    keep = set()
+    for lo, hi in ranges:
+        keep.update(range(lo, hi))
+    skipped_expect = sum(len(body) + len(term)
+                         for i, (body, term) in enumerate(lines)
+                         if i not in keep and body)
+    for budget in budgets:
+        sp = cls(orig)
+        sp.set_stage(ranges)
+        got, got_exc = _drain_or_err(sp, budget)
+        _assert_overlaps_equal(want, got)
+        if want_exc is None:
+            assert got_exc is None, (budget, got_exc)
+            assert sp.stage_skipped_bytes == skipped_expect, budget
+        else:
+            assert got_exc is not None, budget
+            assert type(got_exc) is type(want_exc)
+            assert (str(got_exc).replace(orig, "<f>")
+                    == str(want_exc).replace(masked, "<f>"))
+        sp.close()
+
+
+@pytest.mark.parametrize("ext", ["paf", "paf.gz"])
+@pytest.mark.parametrize("term", [b"\n", b"\r\n"])
+def test_stage_ranges_match_masked_full_parse(tmp_path, ext, term):
+    lines = _paf_lines(12, term=term, blank_every=4)
+    cases = ([(0, 3)], [(2, 7)], [(9, 12)],
+             [(0, 2), (5, 6), (10, 12)], [(0, 12)], [])
+    for i, ranges in enumerate(cases):
+        sub = tmp_path / f"c{i}"
+        sub.mkdir()
+        _staged_vs_masked(sub, lines, ranges, ext=ext,
+                          budgets=(-1, 1, 64))
+
+
+@pytest.mark.parametrize("ext", ["paf", "paf.gz"])
+def test_stage_truncated_final_line(tmp_path, ext):
+    lines = _paf_lines(6, truncate_last=True)
+    for i, ranges in enumerate(([(3, 6)], [(0, 3)])):
+        sub = tmp_path / f"c{i}"
+        sub.mkdir()
+        _staged_vs_masked(sub, lines, ranges, ext=ext)
+
+
+@pytest.mark.parametrize("bad", PAF_ERROR_CASES)
+def test_stage_malformed_in_range_error_text(tmp_path, bad):
+    lines = _paf_lines(8)
+    lines[4] = (bad.rstrip(b"\n"), b"\n")
+    sub = tmp_path / "twin"
+    sub.mkdir()
+    _staged_vs_masked(sub, lines, [(2, 6)], budgets=(-1, 1))
+    # and against the SAME file's full parse: the diagnostic carries
+    # the global (physical) line number, identical text included
+    path = _write(tmp_path, "whole.paf", _join(lines))
+    fp = F.PafScanParser(path)
+    _, whole_exc = _drain_or_err(fp)
+    fp.close()
+    sp = F.PafScanParser(path)
+    sp.set_stage([(2, 6)])
+    _, staged_exc = _drain_or_err(sp)
+    sp.close()
+    assert whole_exc is not None and staged_exc is not None
+    assert str(staged_exc) == str(whole_exc)
+    assert ":5: malformed Paf record" in str(staged_exc)
+
+
+def test_stage_malformed_out_of_range_is_skipped(tmp_path):
+    lines = _paf_lines(8)
+    lines[1] = (PAF_ERROR_CASES[0].rstrip(b"\n"), b"\n")
+    path = _write(tmp_path, "o.paf", _join(lines))
+    fp = F.PafScanParser(path)
+    _, exc = _drain_or_err(fp)
+    fp.close()
+    assert exc is not None           # the full parse chokes on line 2
+    sp = F.PafScanParser(path)
+    sp.set_stage([(3, 8)])
+    got, exc2 = _drain_or_err(sp)
+    sp.close()
+    assert exc2 is None and len(got) == 5
+    sub = tmp_path / "twin"
+    sub.mkdir()
+    _staged_vs_masked(sub, lines, [(3, 8)])
+
+
+def test_stage_none_restores_full_parse(tmp_path):
+    lines = _paf_lines(10)
+    path = _write(tmp_path, "o.paf", _join(lines))
+    full = F.PafScanParser(path)
+    want, _ = _drain_or_err(full)
+    full.close()
+    sp = F.PafScanParser(path)
+    sp.set_stage([(0, 2)])
+    got, _ = _drain_or_err(sp)
+    assert len(got) == 2
+    assert sp.stage_skipped_bytes > 0
+    sp.reset()
+    sp.set_stage(None)
+    got2, _ = _drain_or_err(sp)
+    _assert_overlaps_equal(want, got2)
+    assert sp.stage_skipped_bytes == 0
+    sp.close()
+
+
+def test_stage_mhap_and_sam_ranged(tmp_path):
+    mhap = [(b"%d 1 0.05 0.9 0 5 95 100 0 10 190 200" % i, b"\n")
+            for i in range(7)]
+    sub = tmp_path / "mhap"
+    sub.mkdir()
+    _staged_vs_masked(sub, mhap, [(1, 3), (5, 7)],
+                      cls=F.MhapScanParser, ext="mhap")
+    sam = [(b"@HD\tVN:1.6", b"\n"), (b"@SQ\tSN:t1\tLN:900", b"\n")]
+    sam += [(b"q%d\t0\tt1\t11\t60\t4S20M5I3D2S\t*\t0\t0\tACGT\tIIII" % i,
+             b"\n") for i in range(6)]
+    sub = tmp_path / "sam"
+    sub.mkdir()
+    # the header straddles the first range boundary either way
+    _staged_vs_masked(sub, sam, [(0, 4)], cls=F.SamScanParser,
+                      ext="sam")
+    sub = tmp_path / "sam2"
+    sub.mkdir()
+    _staged_vs_masked(sub, sam, [(3, 8)], cls=F.SamScanParser,
+                      ext="sam")
+
+
+def test_stage_fuzz_random_ranges(tmp_path):
+    rng = random.Random(2121)
+    for trial in range(14):
+        term = rng.choice([b"\n", b"\r\n"])
+        n = rng.randint(1, 40)
+        lines = []
+        for i in range(n):
+            r = rng.random()
+            if r < 0.12:
+                lines.append((b"", term))
+            elif r < 0.2:
+                bad = rng.choice(PAF_ERROR_CASES).rstrip(b"\n")
+                lines.append((bad, term))
+            else:
+                lines.append((_STAGE_ROW % (i, i % 3), term))
+        if rng.random() < 0.3:
+            lines[-1] = (lines[-1][0], b"")
+        cuts = sorted(rng.sample(range(n + 1),
+                                 min(n + 1, rng.randint(2, 6))))
+        ranges = [(cuts[j], cuts[j + 1])
+                  for j in range(0, len(cuts) - 1, 2)]
+        sub = tmp_path / f"t{trial}"
+        sub.mkdir()
+        _staged_vs_masked(sub, lines, ranges,
+                          ext=rng.choice(["paf", "paf.gz"]),
+                          budgets=(-1, rng.choice([1, 17, 257])))

@@ -304,16 +304,22 @@ def test_scatter_knobs_registered_and_epoch_excluded(monkeypatch):
     from racon_tpu.obs import provenance
 
     for n in ("RACON_TPU_SCATTER_MIN_WALL_S",
-              "RACON_TPU_SCATTER_MAX_SHARDS"):
+              "RACON_TPU_SCATTER_MAX_SHARDS",
+              "RACON_TPU_STAGE",
+              "RACON_TPU_SCATTER_REBALANCE"):
         assert n in provenance.KNOWN_KNOBS, n
         assert n in keying.EPOCH_EXCLUDE, n
         monkeypatch.delenv(n, raising=False)
     base = keying.engine_epoch()
     # shard policy is placement policy: a shard's bytes are a slice
     # of the SAME byte stream, so the knobs must never move the
-    # result-cache epoch
+    # result-cache epoch.  Same for r21 staging (pinned byte-identical
+    # to the full parse) and the straggler factor (only moves WHERE an
+    # attempt runs)
     monkeypatch.setenv("RACON_TPU_SCATTER_MIN_WALL_S", "5")
     monkeypatch.setenv("RACON_TPU_SCATTER_MAX_SHARDS", "2")
+    monkeypatch.setenv("RACON_TPU_STAGE", "0")
+    monkeypatch.setenv("RACON_TPU_SCATTER_REBALANCE", "9.5")
     assert keying.engine_epoch() == base
 
 
@@ -325,6 +331,307 @@ def test_faultinject_route_mid_gather_site(monkeypatch):
     assert faultinject.spec() == ("route-mid-gather", 1)
     monkeypatch.delenv("RACON_TPU_FAULT")
     faultinject._reset_for_tests()
+
+
+def test_faultinject_route_mid_rebalance_site(monkeypatch):
+    from racon_tpu.obs import faultinject
+
+    assert "route-mid-rebalance" in faultinject.SITES
+    monkeypatch.setenv("RACON_TPU_FAULT", "route-mid-rebalance:1")
+    assert faultinject.spec() == ("route-mid-rebalance", 1)
+    monkeypatch.delenv("RACON_TPU_FAULT")
+    faultinject._reset_for_tests()
+
+
+def test_rebalance_factor_parsing(monkeypatch):
+    monkeypatch.delenv("RACON_TPU_SCATTER_REBALANCE", raising=False)
+    assert scatter.rebalance_factor() == 2.5       # default ON
+    monkeypatch.setenv("RACON_TPU_SCATTER_REBALANCE", "")
+    assert scatter.rebalance_factor() == 2.5
+    monkeypatch.setenv("RACON_TPU_SCATTER_REBALANCE", "junk")
+    assert scatter.rebalance_factor() == 2.5       # invalid -> default
+    monkeypatch.setenv("RACON_TPU_SCATTER_REBALANCE", "0")
+    assert scatter.rebalance_factor() is None      # <=0 disables
+    monkeypatch.setenv("RACON_TPU_SCATTER_REBALANCE", "-2")
+    assert scatter.rebalance_factor() is None
+    monkeypatch.setenv("RACON_TPU_SCATTER_REBALANCE", "3.75")
+    assert scatter.rebalance_factor() == 3.75
+
+
+def test_rebalance_key_derivation():
+    from racon_tpu.obs import context as obs_context
+
+    assert scatter.rebalance_key("mega", 1, 3, 1) == \
+        "mega-shard-1of3-r1"
+    assert scatter.rebalance_key("mega", 1, 3, 2) == \
+        "mega-shard-1of3-r2"
+    # a replacement attempt is its OWN exactly-once unit: its key
+    # never collides with the original shard key
+    assert scatter.rebalance_key("mega", 1, 3, 1) != \
+        scatter.shard_key("mega", 1, 3)
+    # long bases fold like shard keys do, keeping the full suffix
+    long_base = "k" * 128
+    k1 = scatter.rebalance_key(long_base, 0, 2, 1)
+    assert len(k1) <= 128 and k1.endswith("-shard-0of2-r1")
+    assert obs_context.valid_trace_id(k1)
+    assert scatter.rebalance_key(long_base, 0, 2, 1) == k1
+    assert scatter.rebalance_key(long_base, 0, 2, 2) != k1
+
+
+# ---------------------------------------------------------------------------
+# r21 staged inputs: the slice index (racon_tpu/io/staging.py)
+# ---------------------------------------------------------------------------
+
+def _paf_row(q, t):
+    return (f"{q}\t100\t0\t100\t+\t{t}\t200\t10\t110\t100\t100\t255"
+            .encode())
+
+
+def _staging_fixture(tmp_path):
+    """Five query-runs over four targets, including a split same-query
+    pair (q1 at runs 0 and 2) and an unknown-target run (qX)."""
+    rows = [_paf_row("q1", "t0"),        # run 0: lines 0-1
+            _paf_row("q1", "t0"),
+            _paf_row("q2", "t1"),        # run 1: line 2
+            _paf_row("q1", "t2"),        # run 2: line 3 (same q as 0)
+            _paf_row("qX", "tUNKNOWN"),  # run 3: line 4 (unowned)
+            _paf_row("q4", "t3"),        # run 4: lines 5-6
+            _paf_row("q4", "t3")]
+    path = str(tmp_path / "o.paf")
+    with open(path, "wb") as fh:
+        fh.write(b"\n".join(rows) + b"\n")
+    return path, rows, ["t0", "t1", "t2", "t3"]
+
+
+def test_staging_index_ranges_and_separator_rule(tmp_path):
+    from racon_tpu.io import staging
+
+    path, rows, targets = _staging_fixture(tmp_path)
+    idx = staging.build_index(path, targets)
+    assert idx is not None
+    assert len(idx.run_lo) == 5
+    assert idx.total_lines == 7
+    assert idx.run_targets[3] is None     # unknown target: everywhere
+
+    def plan(owned):
+        return idx.ranges_for([t in owned for t in range(4)])
+
+    # owning t0: its run, plus the stage-everywhere run — and NOT the
+    # q1 run at line 3 (it only touches t2)
+    p = plan({0})
+    assert p["ranges"] == [[0, 2], [4, 5]]
+    assert p["staged_lines"] == 3 and p["total_lines"] == 7
+    assert p["reads"] == 2                # q1, qX
+    assert 0 < p["staged_bytes"] < p["total_bytes"]
+    assert p["staged_bytes"] == len(rows[0]) + len(rows[1]) \
+        + len(rows[4]) + 3                # three newlines
+
+    # owning t0 AND t2 picks both q1 runs; dropping the q2 run between
+    # them would fuse them in the staged stream, so the separator run
+    # is staged too -> one contiguous range through line 4
+    p = plan({0, 2})
+    assert p["ranges"] == [[0, 5]]
+    assert p["staged_lines"] == 5
+
+    # owning t3: the unowned run still rides along, adjacent ranges
+    # merge
+    p = plan({3})
+    assert p["ranges"] == [[4, 7]]
+    assert p["reads"] == 2                # qX, q4
+
+    # owning t1: two disjoint single-run ranges
+    p = plan({1})
+    assert p["ranges"] == [[2, 3], [4, 5]]
+
+    # owning everything stages everything
+    p = plan({0, 1, 2, 3})
+    assert p["ranges"] == [[0, 7]]
+    assert p["staged_bytes"] == p["total_bytes"]
+
+    # the staged stream really is the masked stream: parse each plan's
+    # ranges and check every record's target is owned or unknown
+    for owned in ({0}, {1}, {3}):
+        p = plan(owned)
+        from racon_tpu.io import fastio as fio
+        sp = fio.PafScanParser(path)
+        sp.set_stage(p["ranges"])
+        recs, _ = _drain_scatter(sp)
+        sp.close()
+        names = {f"t{t}" for t in owned} | {"tUNKNOWN"}
+        assert recs and all(r.t_name in names for r in recs)
+
+
+def _drain_scatter(parser):
+    out, rounds = [], 0
+    while parser.parse(out, -1):
+        rounds += 1
+        assert rounds < 100
+    return out, rounds
+
+
+def test_staging_build_index_refusals(tmp_path):
+    from racon_tpu.io import staging
+
+    path, rows, targets = _staging_fixture(tmp_path)
+    # non-PAF extensions never index (v1 is PAF-only)
+    mhap = str(tmp_path / "o.mhap")
+    with open(mhap, "wb") as fh:
+        fh.write(b"0 1 0.05 0.9 0 5 95 100 0 10 190 200\n")
+    assert staging.build_index(mhap, targets) is None
+    # any row the strict column checks reject refuses the WHOLE index
+    # (full-parse fallback keeps the line parser's exact diagnostics)
+    for bad in (b"q1\t100\t0\t100\t+\tt0\t200\t10\n",   # missing col
+                b"q1\t100\txx\t100\t+\tt0\t200\t10\t110\n",
+                b"q\xff\t100\t0\t100\t+\tt0\t200\t10\t110\n"):
+        p = str(tmp_path / "bad.paf")
+        with open(p, "wb") as fh:
+            fh.write(rows[0] + b"\n" + bad)
+        assert staging.build_index(p, targets) is None
+    # missing file
+    assert staging.build_index(str(tmp_path / "gone.paf"),
+                               targets) is None
+
+
+def test_staging_plan_from_hint_validation(tmp_path):
+    from racon_tpu.io import staging
+
+    path, rows, targets = _staging_fixture(tmp_path)
+    idx = staging.build_index(path, targets)
+    hint = staging.shard_hint(idx, (1, 2), len(targets))
+    assert hint["v"] == 1 and hint["format"] == "paf"
+    assert hint["shard"] == [1, 2]
+    # the happy path round-trips the ranges and the accounting
+    plan = staging.plan_from_hint(hint, path, (1, 2))
+    assert plan is not None
+    assert plan["ranges"] == hint["ranges"]
+    assert plan["staged_bytes"] == hint["staged_bytes"]
+    # wrong shard coordinates: a stale hint must never stage the
+    # wrong slice
+    assert staging.plan_from_hint(hint, path, (0, 2)) is None
+    assert staging.plan_from_hint(hint, path, (1, 3)) is None
+    # wrong file
+    other = str(tmp_path / "other.paf")
+    with open(other, "wb") as fh:
+        fh.write(rows[0] + b"\n")
+    assert staging.plan_from_hint(hint, other, (1, 2)) is None
+    # changed file signature (size delta re-keys)
+    with open(path, "ab") as fh:
+        fh.write(rows[0] + b"\n")
+    assert staging.plan_from_hint(hint, path, (1, 2)) is None
+    # malformed shapes
+    for bad in (None, 7, {}, {"v": 2}, dict(hint, ranges=[[5, 3]]),
+                dict(hint, ranges=[[3, 4], [1, 2]]),
+                dict(hint, sig=["x", "y"])):
+        assert staging.plan_from_hint(bad, path, (1, 2)) is None
+
+
+def test_stage_enabled_knob(monkeypatch):
+    from racon_tpu.io import staging
+
+    monkeypatch.delenv("RACON_TPU_STAGE", raising=False)
+    assert staging.stage_enabled() is True         # default ON
+    monkeypatch.setenv("RACON_TPU_STAGE", "0")
+    assert staging.stage_enabled() is False
+    monkeypatch.setenv("RACON_TPU_STAGE", "1")
+    assert staging.stage_enabled() is True
+
+
+def _multi_target_dataset(base):
+    """Three simulated contigs concatenated into ONE job (reads,
+    overlaps and targets), names uniquified per contig — the smallest
+    dataset where target shards own distinct non-empty slices."""
+    import racon_tpu.tools.simulate as simulate
+
+    reads_b = paf_b = draft_b = b""
+    for d in range(3):
+        r, p, t = simulate.simulate(
+            os.path.join(base, f"d{d}"), genome_len=1_200,
+            coverage=4, read_len=300, seed=30 + d, ont=True)
+        tag = b"d%d" % d
+        with open(r, "rb") as fh:
+            reads_b += fh.read().replace(b"@read", b"@" + tag + b"read")
+        with open(p, "rb") as fh:
+            paf_b += fh.read().replace(b"read", tag + b"read") \
+                              .replace(b"\tdraft\t",
+                                       b"\tctg%d\t" % d)
+        with open(t, "rb") as fh:
+            draft_b += fh.read().replace(b">draft", b">ctg%d" % d)
+    reads = os.path.join(base, "reads.fastq")
+    paf = os.path.join(base, "all.paf")
+    draft = os.path.join(base, "draft.fasta")
+    for path, data in ((reads, reads_b), (paf, paf_b),
+                       (draft, draft_b)):
+        with open(path, "wb") as fh:
+            fh.write(data)
+    return reads, paf, draft
+
+
+def test_staged_shard_jobs_byte_identical(tmp_path, monkeypatch):
+    """The r21 staging byte contract through the real serve data
+    plane: each target shard polished with staged parsing (router
+    hint AND daemon self-build) emits exactly the bytes of the
+    unstaged shard, and the 3-shard staged concatenation is the
+    unsharded run."""
+    from racon_tpu.io import staging
+    from racon_tpu.serve.scheduler import JobScheduler
+    from racon_tpu.serve.session import run_job
+
+    # the whole-vs-shard comparison needs the SAME engine per unit in
+    # every run: the poa/align device-cpu splits are per-run policy
+    # (a whole run and a shard run price different totals and can cut
+    # differently, and the two engines resolve cost ties
+    # independently), so pin both splits to device-only — bytes are
+    # pinned per split decision, not across decisions
+    monkeypatch.setenv("RACON_TPU_POA_SPLIT", "1.0")
+    monkeypatch.setenv("RACON_TPU_ALIGN_SPLIT", "1.0")
+    monkeypatch.setenv("RACON_TPU_POA_MEGABATCH", "1")
+
+    reads, paf, draft = _multi_target_dataset(str(tmp_path))
+    names = staging.fasta_names(draft)
+    assert names == ["ctg0", "ctg1", "ctg2"]
+    index = staging.build_index(paf, names)
+    assert index is not None
+
+    sched = JobScheduler(run_job, max_queue=8, max_jobs=1)
+
+    def run(shard=None, stage_env="1", hint=None):
+        monkeypatch.setenv("RACON_TPU_STAGE", stage_env)
+        s = {"sequences": reads, "overlaps": paf, "targets": draft,
+             "threads": 2, "tpu_poa_batches": 1,
+             "tpu_aligner_batches": 1}
+        if shard is not None:
+            s["shard"] = shard
+        if hint is not None:
+            s["stage"] = hint
+        j = sched.submit(s)
+        assert j.done.wait(600) and j.result.get("ok"), j.result
+        return j.result
+
+    try:
+        whole = run(stage_env="0")
+        staged, unstaged = [], []
+        for i in range(3):
+            hint = staging.shard_hint(index, (i, 3), len(names))
+            assert 0 < hint["staged_bytes"] < hint["total_bytes"]
+            hinted = run([i, 3], "1", hint)       # router-shipped hint
+            selfbuilt = run([i, 3], "1")          # daemon self-build
+            plain = run([i, 3], "0")              # full parse
+            assert hinted["fasta_b64"] == plain["fasta_b64"], i
+            assert selfbuilt["fasta_b64"] == plain["fasta_b64"], i
+            gauges = hinted["report"]["run"]["gauges"]
+            assert gauges.get("host.staged_bytes") == \
+                hint["staged_bytes"]
+            assert gauges.get("host.parse_skipped_bytes") == \
+                hint["total_bytes"] - hint["staged_bytes"]
+            staged.append(hinted)
+            unstaged.append(plain)
+    finally:
+        sched.drain(timeout=120)
+    whole_fa = base64.b64decode(whole["fasta_b64"])
+    assert b"".join(base64.b64decode(p["fasta_b64"])
+                    for p in staged) == whole_fa
+    assert b"".join(base64.b64decode(p["fasta_b64"])
+                    for p in unstaged) == whole_fa
 
 
 # ---------------------------------------------------------------------------
@@ -596,6 +903,176 @@ def test_router_scatter_failed_shard_surfaces_shard(monkeypatch):
         doc = client.route_status(rsock)
         assert doc["counters"].get("route_scatter_failed", 0) >= 1
     finally:
+        for stop, sock in stops:
+            stop.set()
+            sock.close()
+        r.request_stop()
+
+
+def test_router_rebalance_straggler_inproc(monkeypatch):
+    """r21 straggler rebalancing end-to-end over stub backends: the
+    backend holding shard 0 stalls; the probe-loop watchdog launches
+    a speculative replacement under the derived ``-r1`` key on an
+    idle backend, the replacement wins the slot, and the gather
+    returns the correct bytes long before the straggler answers."""
+    monkeypatch.setenv("RACON_TPU_ROUTE_PROBE_S", "0.05")
+    # the stalled stub blocks its (serial) accept loop, so probes to
+    # it time out — keep that cheap so watchdog rounds stay fast
+    monkeypatch.setenv("RACON_TPU_ROUTE_PROBE_TIMEOUT_S", "0.2")
+    # tiny factor: threshold collapses to the 4-probe-period floor
+    # (0.2s), so the stalled shard trips the watchdog immediately
+    monkeypatch.setenv("RACON_TPU_SCATTER_REBALANCE", "0.01")
+    tmp = tempfile.mkdtemp(prefix="rtsc_rb_", dir="/tmp")
+    seen = []
+    stops, paths = [], []
+    stall = threading.Event()
+    for i in range(3):
+        path = os.path.join(tmp, f"b{i}.sock")
+        base = _shard_behavior(f"B{i}", seen)
+        if i == 0:
+            # b0 (shard 0's preferred backend) stalls every submit
+            # until released — the straggler
+            def behavior(req, _base=base):
+                if req.get("op") == "submit":
+                    stall.wait(30)
+                return _base(req)
+        else:
+            behavior = base
+        stop, sock = _stub_backend(path, behavior)
+        stops.append((stop, sock))
+        paths.append(path)
+    rsock = os.path.join(tmp, "r.sock")
+    r = router.FleetRouter(rsock, paths)
+    threading.Thread(target=r.serve_forever, daemon=True).start()
+    deadline = time.monotonic() + 20
+    while not os.path.exists(rsock) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(rsock)
+    spec = {"sequences": "/nope", "overlaps": "/nope",
+            "targets": "/nope"}
+    try:
+        t0 = time.monotonic()
+        resp = client.submit(rsock, spec, job_key="megarb", shards=2)
+        wall = time.monotonic() - t0
+        assert resp["ok"], resp
+        # gather order is still SHARD order; the replacement produced
+        # shard 0's bytes, so the merged frame is byte-identical to
+        # what the unstalled fan-out would return
+        assert base64.b64decode(resp["fasta_b64"]) == \
+            b">s0\nAAAA\n>s1\nCCCC\n"
+        assert wall < 20, "gather waited for the straggler"
+        # the slot's lineage marks the handoff...
+        reb = resp["scatter"]["rebalanced"]
+        assert reb[0] == "0of2-r1 <- 0of2", reb
+        assert reb[1] is None
+        # ...and the winning key for shard 0 is the derived -r1 key
+        keys = [p["job_key"] for p in resp["report"]["per_shard"]]
+        assert keys[0] == "megarb-shard-0of2-r1"
+        assert keys[1] == "megarb-shard-1of2"
+        # the replacement ran on a backend the slot had not tried
+        assert resp["scatter"]["backends"][0] in paths[1:]
+        # the superseded original was cancel-broadcast; counters and
+        # the flight trail record the whole flight (the cancel worker
+        # is detached, so poll briefly)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            doc = client.route_status(rsock)
+            if doc["counters"].get("route_cancels", 0) >= 1:
+                break
+            time.sleep(0.05)
+        assert doc["counters"].get("route_rebalance", 0) >= 1
+        assert doc["counters"].get("route_cancels", 0) >= 1
+        assert doc["scatter"]["rebalance_factor"] == 0.01
+        kinds = {e["kind"] for e in client.flight(rsock)["events"]}
+        assert "route_rebalance" in kinds, kinds
+    finally:
+        stall.set()
+        for stop, sock in stops:
+            stop.set()
+            sock.close()
+        r.request_stop()
+
+
+def test_route_status_scatter_rows_carry_staging_and_lineage(
+        monkeypatch):
+    """The r21 telemetry satellite: a live scatter's route_status row
+    carries per-shard staged_bytes / parse_skipped_bytes and the
+    rebalance lineage column."""
+    monkeypatch.setenv("RACON_TPU_ROUTE_PROBE_S", "0.05")
+    monkeypatch.setenv("RACON_TPU_ROUTE_PROBE_TIMEOUT_S", "0.2")
+    monkeypatch.setenv("RACON_TPU_SCATTER_REBALANCE", "0.01")
+    tmp = tempfile.mkdtemp(prefix="rtsc_rs_", dir="/tmp")
+    seen = []
+    stops, paths = [], []
+    # shard 0's original stalls until teardown; the -r1 replacement
+    # stalls until the poll below has SEEN the live row, so the
+    # mid-rebalance route_status snapshot is deterministic, not a
+    # race against a millisecond settle
+    stall = threading.Event()
+    rgate = threading.Event()
+    for i in range(2):
+        path = os.path.join(tmp, f"b{i}.sock")
+        base = _shard_behavior(f"B{i}", seen)
+
+        def behavior(req, _base=base):
+            if req.get("op") == "submit":
+                key = req.get("job_key") or ""
+                if key.endswith("-r1"):
+                    rgate.wait(20)
+                elif key.endswith("-shard-0of2"):
+                    stall.wait(30)
+            return _base(req)
+
+        stop, sock = _stub_backend(path, behavior)
+        stops.append((stop, sock))
+        paths.append(path)
+    rsock = os.path.join(tmp, "r.sock")
+    r = router.FleetRouter(rsock, paths)
+    threading.Thread(target=r.serve_forever, daemon=True).start()
+    deadline = time.monotonic() + 20
+    while not os.path.exists(rsock) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    spec = {"sequences": "/nope", "overlaps": "/nope",
+            "targets": "/nope"}
+    got = {}
+
+    def submit():
+        got["resp"] = client.submit(rsock, spec, job_key="megatl",
+                                    shards=2)
+
+    th = threading.Thread(target=submit, daemon=True)
+    try:
+        th.start()
+        # while shard 0 stalls (both backends tried: b0 holds the
+        # original, b1 got the replacement AND shard 1), the live
+        # route_status row must show the lineage
+        row = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            doc = client.route_status(rsock)
+            active = doc["scatter"]["active"]
+            if active and active[0].get("rebalanced") \
+                    and any(active[0]["rebalanced"]):
+                row = active[0]
+                break
+            time.sleep(0.05)
+        assert row is not None, "no live rebalanced scatter row"
+        assert row["job_key"] == "megatl"
+        assert "staged_bytes" in row
+        assert "parse_skipped_bytes" in row
+        # unstatable spec -> no stage plan -> null accounting, but
+        # the columns are present per shard
+        assert len(row["staged_bytes"]) == 2
+        assert len(row["parse_skipped_bytes"]) == 2
+        assert row["rebalanced"][0] == "0of2-r1 <- 0of2"
+        # release the replacement; the gather completes off it
+        rgate.set()
+        th.join(timeout=30)
+        assert got["resp"]["ok"], got
+    finally:
+        stall.set()
+        rgate.set()
+        th.join(timeout=30)
         for stop, sock in stops:
             stop.set()
             sock.close()
@@ -1007,3 +1484,112 @@ def test_wrapper_scatter_through_router(serve_tmp, dataset, golden,
     finally:
         _stop(proc_a, a_sock)
         _stop(proc_r, r_sock)
+
+
+@pytest.mark.slow
+def test_rebalance_backend_sigkill_exactly_once(serve_tmp, dataset,
+                                                golden, backend_b):
+    """r21 rebalance chaos: an aggressive watchdog (factor 0.01 —
+    every real shard counts as a straggler) sends shard 0's
+    speculative ``-r1`` replacement to the one idle backend, which is
+    armed to SIGKILL the moment it admits a job.  The replacement
+    fails over under its own derived key to a survivor, the originals
+    keep running, first success wins each slot — and the gather is
+    still the one-shot CLI's exact bytes with no derived key ever
+    running twice."""
+    proc_c, c_sock, _ = _start_server(serve_tmp, "rba-c")
+    # A is idle by construction (shards prefer b then c in CLI
+    # order), so the first rebalanced attempt lands on A and dies at
+    # admission — deterministically, before any cancel can beat it
+    proc_a, a_sock, _ = _start_server(
+        serve_tmp, "rba-a",
+        extra_env={"RACON_TPU_FAULT": "post-admit:1"})
+    proc_r, r_sock, _ = _start_router(
+        serve_tmp, "rba-r", [backend_b, c_sock, a_sock],
+        extra_env={"RACON_TPU_ROUTE_PROBE_S": "0.1",
+                   "RACON_TPU_SCATTER_REBALANCE": "0.01"})
+    key = "sc-rebchaos"
+    socks = (backend_b, c_sock, a_sock)
+    try:
+        resp = client.submit(r_sock, _spec(dataset), job_key=key,
+                             shards=2)
+        assert resp["ok"], resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden, (
+            "gather through rebalance + backend SIGKILL diverged "
+            "from the one-shot CLI bytes")
+        assert proc_a.wait(timeout=60) == -signal.SIGKILL
+        # the watchdog fired and the reply says which slots handed
+        # over (the winner per slot may be either attempt — both
+        # return the same bytes by the target_slice contract)
+        assert any(resp["scatter"]["rebalanced"]), resp["scatter"]
+        doc = client.route_status(r_sock)
+        assert doc["counters"].get("route_rebalance", 0) >= 1
+        kinds = {e["kind"] for e in client.flight(r_sock)["events"]}
+        assert "route_rebalance" in kinds, kinds
+
+        # exactly-once per DERIVED KEY across the fleet's journals
+        # (backend_b is shared module-wide — filter to this job):
+        # originals, -r1, -r2 each ran at most once, wherever
+        # failover and cancellation left them
+        done = [k for k in _done_keys(*socks) if k.startswith(key)]
+        assert len(done) == len(set(done)), done
+        # each slot's winner has exactly one done record
+        for p in resp["report"]["per_shard"]:
+            assert done.count(p["job_key"]) == 1, (p, done)
+    finally:
+        if proc_a.poll() is None:
+            proc_a.kill()
+        _stop(proc_c, c_sock)
+        _stop(proc_r, r_sock)
+
+
+@pytest.mark.slow
+def test_router_sigkill_mid_rebalance_originals_win(serve_tmp,
+                                                    dataset, golden,
+                                                    backend_b):
+    """SIGKILL of the ROUTER at the route-mid-rebalance fault site:
+    the watchdog dies after deciding to rebalance but BEFORE
+    launching the replacement or cancelling anything, so no ``-r``
+    key exists anywhere; the original shard jobs keep running on
+    their backends and journal normally, and the keyed retry through
+    a restarted router (watchdog off) is answered by join/dedup —
+    same bytes, every shard exactly once, zero replacement keys in
+    any journal."""
+    proc_a, a_sock, _ = _start_server(serve_tmp, "mrb-a")
+    proc_r, r_sock, r_log = _start_router(
+        serve_tmp, "mrb-r", [a_sock, backend_b],
+        extra_env={"RACON_TPU_ROUTE_PROBE_S": "0.1",
+                   "RACON_TPU_SCATTER_REBALANCE": "0.01",
+                   "RACON_TPU_FAULT": "route-mid-rebalance:1"})
+    key = "sc-midreb"
+    try:
+        with pytest.raises(client.ServeError):
+            client.submit(r_sock, _spec(dataset), job_key=key,
+                          shards=2)
+        assert proc_r.wait(timeout=300) == -signal.SIGKILL
+        # the kill came from the armed site, not a bystander crash:
+        # the watchdog logged its handoff decision first
+        with open(r_log) as fh:
+            assert "rebalance: shard" in fh.read()
+
+        # watchdog OFF on the restarted router: the retry must be fed
+        # by the surviving originals, not by a fresh speculation
+        proc_r2, _, _ = _start_router(
+            serve_tmp, "mrb-r", [a_sock, backend_b],
+            extra_env={"RACON_TPU_SCATTER_REBALANCE": "0"})
+        try:
+            resp = client.submit(r_sock, _spec(dataset), job_key=key,
+                                 shards=2)
+            assert resp["ok"], resp
+            assert base64.b64decode(resp["fasta_b64"]) == golden
+            assert resp["scatter"]["rebalanced"] == [None, None]
+            done = [k for k in _done_keys(a_sock, backend_b)
+                    if k.startswith(key)]
+            assert sorted(done) == \
+                [f"{key}-shard-{i}of2" for i in range(2)], done
+            assert not any(k.endswith(("-r1", "-r2"))
+                           for k in done), done
+        finally:
+            _stop(proc_r2, r_sock)
+    finally:
+        _stop(proc_a, a_sock)
