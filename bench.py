@@ -335,6 +335,11 @@ def _simulated_fallback():
     except Exception as exc:
         log(f"[bench] route_scatter bench skipped "
             f"({type(exc).__name__}: {exc})")
+    try:
+        record.update(route_affinity_bench())
+    except Exception as exc:
+        log(f"[bench] route_affinity bench skipped "
+            f"({type(exc).__name__}: {exc})")
     print(json.dumps(record))
 
 
@@ -595,6 +600,12 @@ def main():
             extra.update(route_scatter_bench())
         except Exception as exc:
             log(f"[bench] route_scatter bench skipped "
+                f"({type(exc).__name__}: {exc})")
+
+        try:
+            extra.update(route_affinity_bench())
+        except Exception as exc:
+            log(f"[bench] route_affinity bench skipped "
                 f"({type(exc).__name__}: {exc})")
 
     record = {
@@ -1251,6 +1262,187 @@ def route_scatter_bench():
         f"(speedup {staged_speedup:.2f}x, parse "
         f"{parse_staged} vs {parse_full}); bytes equal: "
         f"{out['route_scatter_bytes_equal']}")
+    return out
+
+
+def route_affinity_bench():
+    """Content-affinity routing leg (r22): the SAME content-keyed
+    job repeated through a real 3-backend router (subprocess daemons
+    — each with its OWN result cache, which is the whole point; the
+    in-process backends of the other legs share one cache and would
+    show 100% warmth under any placement).  Affinity ON
+    (RACON_TPU_ROUTE_AFFINITY=1): the router prices each submit's
+    content-digest sample against every backend's cache sketch, so
+    warm repeats land where the units already live — the fleet-wide
+    warm hit ratio should approach a single backend's.  Affinity
+    OFF: load/price ranking spreads repeats over idle backends, so
+    each lands cold (~1/N warmth).  Reports
+    ``route_affinity_hit_ratio`` (warm repeats, affinity on),
+    ``route_affinity_off_hit_ratio``, ``route_affinity_speedup``
+    (warm wall off / on) and the byte-identity bit.  Backends run on
+    forced-CPU JAX, so the rate metric is always provenance-marked —
+    the win measured here is cache locality, not device speed.
+    Default ON; RACON_TPU_BENCH_ROUTE_AFFINITY=0 disables."""
+    if os.environ.get("RACON_TPU_BENCH_ROUTE_AFFINITY", "1") != "1":
+        return {}
+    if not _budget_left(300 * _host_factor(), "route_affinity leg"):
+        return {}
+    import base64
+    import socket as socketlib
+    import subprocess
+    import tempfile
+
+    from racon_tpu.serve import client as serve_client
+    from racon_tpu.tools import simulate
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    n_backends = 3
+    repeats = 3
+
+    def wait_listening(proc, sock_path, log_path, what):
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                with open(log_path) as fh:
+                    raise RuntimeError(
+                        f"{what} died at startup: " + fh.read()[-2000:])
+            if os.path.exists(sock_path):
+                probe = socketlib.socket(socketlib.AF_UNIX)
+                try:
+                    probe.connect(sock_path)
+                except OSError:
+                    pass
+                else:
+                    return
+                finally:
+                    probe.close()
+            time.sleep(0.2)
+        proc.kill()
+        raise RuntimeError(f"{what} socket never came up")
+
+    def start(tmp, name, cli_args, env):
+        sock_path = os.path.join(tmp, name + ".sock")
+        log_path = os.path.join(tmp, name + ".log")
+        with open(log_path, "ab") as logf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "racon_tpu.cli", *cli_args,
+                 "--socket", sock_path],
+                cwd=repo_root, stdout=logf, stderr=logf, env=env)
+        wait_listening(proc, sock_path, log_path, name)
+        return proc, sock_path
+
+    def stop(proc, sock_path):
+        if proc.poll() is None:
+            try:
+                serve_client.admin(sock_path, "shutdown")
+            except serve_client.ServeError:
+                proc.terminate()
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def cache_counts(socks):
+        hits = misses = 0
+        for s in socks:
+            doc = serve_client.metrics(s)
+            c = ((doc.get("snapshot") or {}).get("counters")) or {}
+            hits += int(c.get("cache_hit", 0))
+            misses += int(c.get("cache_miss", 0))
+        return hits, misses
+
+    def one_round(affinity, reads, paf, draft, tmp):
+        probe_s = 0.4
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "RACON_TPU_CLI_PREWARM": "0",
+            "RACON_TPU_CACHE": "1",
+            "RACON_TPU_ROUTE_AFFINITY": "1" if affinity else "0",
+            "RACON_TPU_ROUTE_PROBE_S": str(probe_s),
+        })
+        env.pop("RACON_TPU_CACHE_PERSIST", None)
+        env.pop("RACON_TPU_TRACE", None)
+        env.pop("RACON_TPU_METRICS_JSON", None)
+        backends = [start(tmp, f"{'on' if affinity else 'off'}-b{i}",
+                          ("serve",), env)
+                    for i in range(n_backends)]
+        socks = [s for _, s in backends]
+        router_proc, router_sock = start(
+            tmp, f"{'on' if affinity else 'off'}-router",
+            ("route", "--backends", ",".join(socks)), env)
+        spec = {"sequences": reads, "overlaps": paf,
+                "targets": draft, "threads": 2,
+                "tpu_poa_batches": 1, "tpu_aligner_batches": 1,
+                "tenant": "affbench"}
+        try:
+            fastas, walls = [], []
+            for i in range(repeats + 1):
+                t0 = time.monotonic()
+                resp = serve_client.submit(
+                    router_sock, dict(spec),
+                    job_key=f"affbench-{'on' if affinity else 'off'}"
+                            f"-{i}")
+                walls.append(time.monotonic() - t0)
+                if not resp.get("ok"):
+                    raise RuntimeError(
+                        f"route_affinity job {i} failed: "
+                        f"{resp.get('error')}")
+                fastas.append(resp["fasta_b64"])
+                if i == 0:
+                    cold_hits, cold_misses = cache_counts(socks)
+                # let the next probe round carry the freshly filled
+                # cache sketch to the router before the next submit
+                time.sleep(3 * probe_s)
+            hits, misses = cache_counts(socks)
+            warm_hits = hits - cold_hits
+            warm_total = warm_hits + (misses - cold_misses)
+            hit_ratio = warm_hits / warm_total if warm_total else 0.0
+        finally:
+            stop(router_proc, router_sock)
+            for proc, s in backends:
+                stop(proc, s)
+        warm_wall = sum(walls[1:]) / max(1, len(walls) - 1)
+        return {"cold_wall_s": walls[0], "warm_wall_s": warm_wall,
+                "hit_ratio": round(hit_ratio, 4), "fastas": fastas}
+
+    with tempfile.TemporaryDirectory(prefix="racon_affinity_") as tmp:
+        reads, paf, draft = simulate.simulate(
+            tmp, genome_len=60_000, coverage=8, read_len=3000,
+            seed=31)
+        on = one_round(True, reads, paf, draft, tmp)
+        off = one_round(False, reads, paf, draft, tmp)
+    all_fastas = on["fastas"] + off["fastas"]
+    bytes_equal = all(f == all_fastas[0] for f in all_fastas)
+    if not bytes_equal:
+        # placement must never change bytes — this is a correctness
+        # failure, not a slow run
+        raise RuntimeError(
+            "route_affinity bytes diverged between affinity-on and "
+            "affinity-off routed repeats")
+    speedup = round(off["warm_wall_s"] /
+                    max(on["warm_wall_s"], 1e-9), 3)
+    out = {
+        "route_affinity_backends": n_backends,
+        "route_affinity_repeats": repeats,
+        "route_affinity_cold_wall_s": round(on["cold_wall_s"], 3),
+        "route_affinity_warm_wall_s": round(on["warm_wall_s"], 3),
+        "route_affinity_off_warm_wall_s": round(
+            off["warm_wall_s"], 3),
+        "route_affinity_hit_ratio": on["hit_ratio"],
+        "route_affinity_off_hit_ratio": off["hit_ratio"],
+        "route_affinity_speedup": speedup,
+        "route_affinity_bytes_equal": bytes_equal,
+        # the subprocess fleet always runs forced-CPU JAX: the rate
+        # is a cache-locality proxy, never a device-speed reference
+        "route_affinity_speedup_provenance":
+            f"cpu-backend:{os.cpu_count() or 1}-core",
+    }
+    log(f"[bench] route_affinity: warm hit ratio "
+        f"{on['hit_ratio']:.0%} on vs {off['hit_ratio']:.0%} off, "
+        f"warm wall {on['warm_wall_s']:.1f}s on vs "
+        f"{off['warm_wall_s']:.1f}s off (speedup {speedup:.2f}x); "
+        f"bytes equal: {bytes_equal}")
     return out
 
 

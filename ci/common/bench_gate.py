@@ -81,6 +81,7 @@ SHARE_METRICS = (
     "serve_sat_fusion_occupancy",
     "serve_cache_hit_ratio",
     "route_scatter_efficiency",
+    "route_affinity_hit_ratio",
 )
 
 #: throughput metrics, higher is better (relative threshold, shares
@@ -90,6 +91,7 @@ RATE_METRICS = (
     "serve_cache_warm_jobs_per_s",
     "route_scatter_speedup",
     "route_scatter_staged_speedup",
+    "route_affinity_speedup",
 )
 
 #: absolute slack for edit-distance drift on top of the relative tol

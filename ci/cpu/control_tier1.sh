@@ -1,0 +1,136 @@
+#!/usr/bin/env bash
+# Closed-control-loop tier-1 (ISSUE r22 CI satellite): the control
+# loop — content-affinity routing, the adaptive fusion window,
+# drift-triggered recalibration epochs, deadline classes — is pure
+# policy.  Placement, pacing, admission ordering and recalibration
+# timing may all move; output bytes may not.
+#
+#   1. tier-1 with every r22 control knob pinned ON
+#      (RACON_TPU_ROUTE_AFFINITY=1 is the default; the pin keeps the
+#      lane meaningful if that ever changes — FUSE_ADAPT and
+#      CALIB_DRIFT_EPOCH default OFF, so this is the only lane that
+#      runs the whole suite with the controllers live).
+#      PYTHONDEVMODE=1 surfaces unjoined controller threads and
+#      leaked sockets; the faulthandler timeout dumps all stacks if
+#      an adaptive wait or drift epoch ever deadlocks.
+#   2. 2-backend affinity-routing byte smoke: the same content-keyed
+#      job submitted twice through a real router over two subprocess
+#      daemons with affinity on — the warm repeat must re-land on
+#      the warmed backend (sketch-priced placement) and BOTH routed
+#      responses must be byte-identical to the one-shot CLI run of
+#      the same inputs.  The in-suite twins (tests/test_control.py)
+#      pin the same contracts; this leg re-checks the end-to-end
+#      socket path standalone.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export PYTHONDEVMODE=1
+export RACON_TPU_ROUTE_AFFINITY=1
+export RACON_TPU_FUSE_ADAPT=1
+export RACON_TPU_CALIB_DRIFT_EPOCH=1
+unset RACON_TPU_FAULT || true
+python -m pytest tests/ -q -m "not slow" \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"
+
+echo "[control_tier1] 2-backend affinity routing vs one-shot CLI"
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+JAX_PLATFORMS=cpu python - "$work" <<'EOF'
+import base64
+import os
+import socket
+import subprocess
+import sys
+import time
+
+from racon_tpu.tools import simulate
+
+work = sys.argv[1]
+reads, paf, draft = simulate.simulate(work, genome_len=12_000,
+                                      coverage=5, read_len=900,
+                                      seed=7, ont=True)
+env = dict(os.environ)
+env.update({"JAX_PLATFORMS": "cpu", "RACON_TPU_CLI_PREWARM": "0",
+            "RACON_TPU_CACHE": "1",
+            "RACON_TPU_ROUTE_AFFINITY": "1",
+            "RACON_TPU_ROUTE_PROBE_S": "0.4"})
+env.pop("RACON_TPU_CACHE_PERSIST", None)
+
+ref = subprocess.run(
+    [sys.executable, "-m", "racon_tpu.cli", "-t", "4", "-c", "1",
+     "--tpualigner-batches", "1", reads, paf, draft],
+    capture_output=True, env=env, timeout=600)
+assert ref.returncode == 0, ref.stderr.decode()
+assert ref.stdout.startswith(b">")
+
+
+def start(name, args):
+    sock = os.path.join(work, name + ".sock")
+    log_path = os.path.join(work, name + ".log")
+    with open(log_path, "ab") as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "racon_tpu.cli", *args,
+             "--socket", sock],
+            stdout=log, stderr=log, env=env)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                name + " died: " + open(log_path).read()[-2000:])
+        if os.path.exists(sock):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock)
+            except OSError:
+                pass
+            else:
+                break
+            finally:
+                probe.close()
+        time.sleep(0.2)
+    else:
+        proc.kill()
+        raise AssertionError(name + " socket never came up")
+    return proc, sock
+
+
+from racon_tpu.serve import client
+
+procs = []
+try:
+    b0, s0 = start("b0", ("serve",))
+    b1, s1 = start("b1", ("serve",))
+    procs += [(b0, s0), (b1, s1)]
+    router, rsock = start("router",
+                          ("route", "--backends", s0 + "," + s1))
+    procs.append((router, rsock))
+    spec = {"sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 4, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1, "tenant": "ctrlsmoke"}
+    cold = client.submit(rsock, dict(spec), job_key="ctrl-cold")
+    assert cold.get("ok"), cold.get("error")
+    warmed = cold["routed_backend"]
+    time.sleep(1.5)   # next probe round carries the filled sketch
+    warm = client.submit(rsock, dict(spec), job_key="ctrl-warm")
+    assert warm.get("ok"), warm.get("error")
+    assert warm["routed_backend"] == warmed, (
+        "warm repeat did not re-land on the warmed backend: "
+        f"{warm['routed_backend']} != {warmed}")
+    for resp in (cold, warm):
+        assert base64.b64decode(resp["fasta_b64"]) == ref.stdout, \
+            "routed bytes != one-shot CLI bytes"
+finally:
+    for proc, sock in procs:
+        if proc.poll() is None:
+            try:
+                client.admin(sock, "shutdown")
+            except Exception:
+                proc.terminate()
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+print("affinity-routed bytes == one-shot CLI bytes; "
+      "warm repeat re-landed on " + warmed)
+EOF
+echo "CONTROL CI PASS"

@@ -108,6 +108,25 @@ def stats() -> dict:
     return live.stats()
 
 
+def sketch_doc():
+    """Epoch-tagged digest-sketch export of the live cache, or None
+    when the cache is disabled or not yet instantiated (a router
+    treats an absent sketch as cold)."""
+    if not enabled():
+        return None
+    with _lock:
+        live = _cache
+    return live.sketch_doc() if live is not None else None
+
+
+def note_content(digest: bytes) -> None:
+    """Mark a job-level content digest warm in the live cache's
+    sketch (no-op when the cache is disabled)."""
+    if not enabled():
+        return
+    result_cache().note_content(digest)
+
+
 def _reset_for_tests() -> None:
     global _cache, _cfg
     with _lock:

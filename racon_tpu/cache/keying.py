@@ -79,6 +79,17 @@ EPOCH_EXCLUDE = frozenset({
     # straggler factor only moves WHERE a shard's attempt runs
     "RACON_TPU_STAGE",
     "RACON_TPU_SCATTER_REBALANCE",
+    # r22 closed control loop: affinity routing moves WHERE a job
+    # runs, the adaptive fusion window moves WHEN a bucket
+    # dispatches, drift epochs move WHEN rates recalibrate (per-job
+    # pins keep in-flight jobs on their admission snapshot), and the
+    # class knobs move ordering/admission — all pinned byte-identical
+    # on/off (tests/test_control.py)
+    "RACON_TPU_ROUTE_AFFINITY",
+    "RACON_TPU_FUSE_ADAPT",
+    "RACON_TPU_CALIB_DRIFT_EPOCH",
+    "RACON_TPU_CLASS_TARGET_P99_S",
+    "RACON_TPU_CLASS_HEADROOM",
 })
 
 DIGEST_SIZE = 32

@@ -34,7 +34,7 @@ import threading
 import zlib
 from collections import OrderedDict
 
-from racon_tpu.cache import codec
+from racon_tpu.cache import codec, sketch
 from racon_tpu.obs import REGISTRY
 
 SCHEMA = "racon-tpu-rcache-v1"
@@ -66,12 +66,22 @@ class ResultCache:
         self._pindex: dict = {}
         self._seg = None
         self._seg_path = None
+        # digest sketch (r22): counting Bloom over every live key —
+        # LRU ∪ persistent index ∪ job-level content digests — the
+        # compact warmth summary the fleet router prices against.
+        # Maintained under self._lock next to the structures it
+        # mirrors; drift (saturation, content digests outliving their
+        # units) only mis-prices placement, never bytes.
+        self._sketch = sketch.DigestSketch()
+        self._content_n = 0
         if persist_dir:
             try:
                 os.makedirs(persist_dir, exist_ok=True)
                 self._scan_segments()
             except OSError:
                 self.persist_dir = None
+        for key in self._pindex:
+            self._sketch.add(key)
 
     # -- lookups -----------------------------------------------------------
 
@@ -107,7 +117,9 @@ class ResultCache:
                 dropped = self._lru.pop(key, None)
                 if dropped is not None:
                     self._bytes -= len(dropped)
-                self._pindex.pop(key, None)
+                if self._pindex.pop(key, None) is not None \
+                        or dropped is not None:
+                    self._sketch.discard(key)
                 self._hits -= 1
                 self._misses += 1
                 self._note_lookup(hit=False)
@@ -136,11 +148,20 @@ class ResultCache:
             return                      # larger than the whole budget
         self._lru[key] = blob
         self._bytes += len(blob)
+        if key not in self._pindex:
+            # pindex keys are already sketched (seed scan / append),
+            # so a disk-hit promotion must not double-count its key
+            self._sketch.add(key)
         while self.budget and self._bytes > self.budget and \
                 len(self._lru) > 1:
-            _, old = self._lru.popitem(last=False)
+            old_key, old = self._lru.popitem(last=False)
             self._bytes -= len(old)
             self._evicts += 1
+            if old_key not in self._pindex:
+                # still reachable through the persistent tier = still
+                # warm for placement purposes; only a full departure
+                # leaves the sketch
+                self._sketch.discard(old_key)
             REGISTRY.add("cache_evict")
         REGISTRY.set("cache_bytes", self._bytes)
 
@@ -249,6 +270,30 @@ class ResultCache:
                 self._seg = None
                 self.persist_dir = None
 
+    # -- digest sketch (r22) -----------------------------------------------
+
+    def note_content(self, digest: bytes) -> None:
+        """Record a job-level content digest (serve/affinity.py
+        ``job_digest_sample``) as warm: the router derives the same
+        digests from a submit's input files and scores them against
+        this sketch.  Content digests are never discarded (they do
+        not map 1:1 to evictable entries); a long-lived daemon's
+        sketch therefore over-reports old content — a placement
+        mis-pricing that decays as jobs churn, never a bytes risk."""
+        with self._lock:
+            self._sketch.add(digest)
+            self._content_n += 1
+
+    def sketch_doc(self) -> dict:
+        """The epoch-tagged wire export of the digest sketch (see
+        :mod:`racon_tpu.cache.sketch`)."""
+        from racon_tpu.cache import keying
+
+        epoch_hex = keying.engine_epoch().hex()
+        with self._lock:
+            n = len(self._lru) + len(self._pindex) + self._content_n
+            return self._sketch.export(epoch_hex, n)
+
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> dict:
@@ -266,6 +311,9 @@ class ResultCache:
                 "disk_hits": self._disk_hits,
                 "hit_ratio": (round(self._hits / total, 4)
                               if total else 0.0),
+                "sketch_adds": self._sketch.adds,
+                "sketch_drops": self._sketch.drops,
+                "sketch_content": self._content_n,
             }
             if self.persist_dir:
                 doc["persist"] = {"dir": self.persist_dir,

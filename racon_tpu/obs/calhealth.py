@@ -173,6 +173,19 @@ def stage_ewma(snapshot: dict, stage: str):
     return row.get("ewma") if row else None
 
 
+def reset_stage(stage: str) -> None:
+    """Forget one stage's smoothed drift state (r22 drift-triggered
+    recalibration epochs, racon_tpu/serve/scheduler.py): the next
+    observation re-seeds the EWMA, so after a recalibration pass the
+    drift flag measures the NEW rates instead of averaging across
+    the epoch boundary.  The registry gauge keeps its last value
+    until that next observation — the scheduler's reopen cooldown
+    covers the gap."""
+    with _lock:
+        _ewma.pop(stage, None)
+        _unit_rate.pop(stage, None)
+
+
 def _reset_for_tests() -> None:
     with _lock:
         _ewma.clear()

@@ -139,6 +139,16 @@ KNOWN_KNOBS = {
     # a shard runs — both epoch-excluded like every placement knob.
     "RACON_TPU_STAGE": "1",
     "RACON_TPU_SCATTER_REBALANCE": "2.5",
+    # r22 closed control loop: content-affinity routing (sketch-priced
+    # placement), the adaptive fusion window, drift-triggered
+    # recalibration epochs, and the deadline-class SLO targets.  All
+    # pure policy — placement, pacing and admission, never bytes — so
+    # cache/keying.py EXCLUDES every one from the engine epoch.
+    "RACON_TPU_ROUTE_AFFINITY": "1",
+    "RACON_TPU_FUSE_ADAPT": "0",
+    "RACON_TPU_CALIB_DRIFT_EPOCH": "0",
+    "RACON_TPU_CLASS_TARGET_P99_S": "2.0",
+    "RACON_TPU_CLASS_HEADROOM": "0.125",
 }
 
 # host-capability probe reference wall (bench.py's budget scaling):

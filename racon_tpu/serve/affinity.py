@@ -1,0 +1,121 @@
+"""Content-affinity scoring for fleet placement (r22).
+
+The result cache keys units by full content digests, so "is backend
+X warm for THIS job?" reduces to set membership: did X recently
+cache units derived from the same input content?  Unit keys proper
+(poa/wfa/band/scan) only exist after overlap parsing and window
+construction — far too heavy for a router sizing up a submit — so
+placement uses **job-level content digests**: a fixed, cheap sample
+of digests over the submit's input files (size + head / middle /
+tail chunks per role, shard-mask- and engine-epoch-folded).  Both
+sides derive the identical sample from the spec alone:
+
+* the daemon notes the sample into its cache sketch when a job
+  completes (``rcache.note_content``) — "this content's units are
+  warm here now";
+* the router derives the same sample at submit and asks every
+  backend's exported sketch what fraction it contains
+  (:func:`racon_tpu.cache.sketch.hit_fraction`), feeding that
+  estimate into the predicted-wall pricing as the ``hit_ratio``
+  discount.
+
+Folding the engine epoch into every digest means a backend running
+a different knob environment — whose cached unit results would NOT
+be reusable — naturally scores cold, without any cross-environment
+negotiation; the exported sketch's epoch tag makes the same check
+explicit and cheap.  Everything here prices placement only: a wrong
+fraction (false positive, stale sketch, evicted-but-sticky counter)
+routes a job somewhere slower, never changes its bytes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from racon_tpu.cache import keying, sketch
+
+#: per-role chunk size for the content digests (head/middle/tail)
+CHUNK = 1 << 16
+
+_ROLES = ("sequences", "overlaps", "targets")
+
+
+def _file_digests(role: str, path: str, shard_tag: bytes,
+                  epoch: bytes):
+    """Up to four digests for one input file: whole-file signature
+    (role + size) plus head/middle/tail chunk digests.  Unreadable
+    files yield nothing — the sample just shrinks."""
+    try:
+        size = os.stat(path).st_size
+    except OSError:
+        return
+    base = b"aff1|" + role.encode() + b"|" + shard_tag + b"|" + epoch
+    h = hashlib.blake2b(digest_size=keying.DIGEST_SIZE)
+    h.update(base + b"|size|%d" % size)
+    yield h.digest()
+    offsets = sorted({0, max(0, size // 2 - CHUNK // 2),
+                      max(0, size - CHUNK)})
+    try:
+        with open(path, "rb") as f:
+            for slot, off in enumerate(offsets):
+                f.seek(off)
+                chunk = f.read(CHUNK)
+                h = hashlib.blake2b(digest_size=keying.DIGEST_SIZE)
+                h.update(base + b"|c%d|%d|" % (slot, size))
+                h.update(chunk)
+                yield h.digest()
+    except OSError:
+        return
+
+
+def job_digest_sample(spec: dict, epoch: bytes = None) -> list:
+    """The submit's content-digest sample: up to 12 32-byte digests
+    (4 per input role).  Deterministic in (input bytes, shard mask,
+    engine epoch) — the same function on router and daemon yields
+    the same sample for the same spec."""
+    if epoch is None:
+        epoch = keying.engine_epoch()
+    shard = spec.get("shard")
+    if isinstance(shard, (list, tuple)) and len(shard) == 2:
+        shard_tag = b"s%d/%d" % (int(shard[0]), int(shard[1]))
+    else:
+        shard_tag = b"s0/1"
+    out = []
+    for role in _ROLES:
+        path = spec.get(role)
+        if isinstance(path, str) and path:
+            out.extend(_file_digests(role, path, shard_tag, epoch))
+    return out
+
+
+def note_job_content(spec: dict) -> None:
+    """Daemon side: mark a completed job's content sample warm in
+    the local cache sketch.  Never raises — affinity bookkeeping
+    must not fail a finished job."""
+    try:
+        from racon_tpu import cache as rcache
+
+        if not rcache.enabled():
+            return
+        for digest in job_digest_sample(spec):
+            rcache.note_content(digest)
+    except Exception:
+        pass
+
+
+def backend_hit_fraction(sketch_doc, sample, epoch_hex: str):
+    """Router side: estimated fraction of ``sample`` warm in one
+    backend's exported sketch.  None — "no usable sketch, fall back"
+    — when the doc is absent/undecodable or tagged with a different
+    engine epoch than ours (its cached units are not reusable
+    here)."""
+    if not sample or not isinstance(sketch_doc, dict):
+        return None
+    if sketch_doc.get("epoch") != epoch_hex:
+        return None
+    bits = sketch.decode_bits(sketch_doc)
+    if bits is None:
+        return None
+    hits = sum(1 for d in sample if sketch.bits_contain(bits, d))
+    return hits / len(sample)

@@ -283,12 +283,17 @@ def watch(socket_path: str, interval_s: float = 1.0, count: int = 0,
         sock.close()
 
 
-def spec_from_opts(opts: dict, inputs, tenant: str = None) -> dict:
+def spec_from_opts(opts: dict, inputs, tenant: str = None,
+                   job_class: str = None) -> dict:
     """One-shot CLI options -> job spec (racon_tpu/serve/session.py
     resolves omitted keys to the same CLI defaults).  ``tenant`` tags
     the job for the fused device executor's per-tenant fairness and
-    SLO accounting; it never affects output bytes."""
+    SLO accounting; ``job_class`` (r22, ``--class``) picks the
+    deadline class (interactive|batch).  Neither affects output
+    bytes."""
     spec = {} if tenant is None else {"tenant": tenant}
+    if job_class is not None:
+        spec["class"] = job_class
     spec.update({
         "sequences": os.path.abspath(inputs[0]),
         "overlaps": os.path.abspath(inputs[1]),
@@ -311,11 +316,11 @@ def spec_from_opts(opts: dict, inputs, tenant: str = None) -> dict:
 
 
 def _split_serve_flags(argv):
-    """Pull --socket/--priority/--tenant/--trace-context/--job-key/
-    --retry/--shards out of the argv so the rest parses with the
-    unchanged one-shot ``cli.parse_args``."""
+    """Pull --socket/--priority/--tenant/--class/--trace-context/
+    --job-key/--retry/--shards out of the argv so the rest parses
+    with the unchanged one-shot ``cli.parse_args``."""
     socket_path, priority, tenant, trace_context = None, 0, None, None
-    job_key, retry, shards = None, 0, None
+    job_key, retry, shards, job_class = None, 0, None, None
     rest = []
     i = 0
     while i < len(argv):
@@ -355,23 +360,33 @@ def _split_serve_flags(argv):
             shards = argv[i] if i < len(argv) else None
         elif a.startswith("--shards="):
             shards = a.split("=", 1)[1]
+        elif a == "--class":
+            i += 1
+            job_class = argv[i] if i < len(argv) else None
+        elif a.startswith("--class="):
+            job_class = a.split("=", 1)[1]
         else:
             rest.append(a)
         i += 1
     if shards is not None and shards != "auto":
         shards = int(shards)
     return (socket_path, priority, tenant, trace_context, job_key,
-            retry, shards, rest)
+            retry, shards, job_class, rest)
 
 
 def main_submit(argv) -> int:
     from racon_tpu import cli
 
     socket_path, priority, tenant, trace_context, job_key, retry, \
-        shards, rest = _split_serve_flags(argv)
+        shards, job_class, rest = _split_serve_flags(argv)
     if not socket_path:
         print("[racon_tpu::submit] error: --socket PATH is required!",
               file=sys.stderr)
+        return 1
+    if job_class is not None and \
+            job_class not in ("interactive", "batch"):
+        print("[racon_tpu::submit] error: --class must be "
+              "'interactive' or 'batch'!", file=sys.stderr)
         return 1
     opts, inputs = cli.parse_args(rest)
     if len(inputs) < 3:
@@ -380,7 +395,8 @@ def main_submit(argv) -> int:
         return 1
     try:
         resp = submit_with_retry(
-            socket_path, spec_from_opts(opts, inputs, tenant=tenant),
+            socket_path, spec_from_opts(opts, inputs, tenant=tenant,
+                                        job_class=job_class),
             priority=priority, retries=max(0, retry),
             want_trace=bool(opts["trace"]),
             trace_context=trace_context, job_key=job_key,
@@ -480,7 +496,7 @@ def _print_router_status(doc: dict) -> int:
 
 
 def main_status(argv) -> int:
-    socket_path, _, _, _, _, _, _, rest = _split_serve_flags(argv)
+    socket_path, _, _, _, _, _, _, _, rest = _split_serve_flags(argv)
     as_json = "--json" in rest
     rest = [a for a in rest if a != "--json"]
     if not socket_path or rest:

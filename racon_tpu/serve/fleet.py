@@ -283,6 +283,31 @@ def watch_fleet(targets, interval_s: float = None, count: int = 0,
         yield {"target": t, "frame": frame}
 
 
+def resolve_fleet_targets(fleet_arg: str, timeout: float = None):
+    """``--fleet`` argument -> daemon socket list (r22).
+
+    A comma-separated value is the explicit backend list, as before.
+    A single target is probed with ``route_status`` first: a router
+    answers with its backend table and the fleet view auto-discovers
+    from it (``--fleet ROUTER_SOCK``); a plain daemon (or anything
+    that refuses the op) falls back to being the one-element fleet.
+    Discovery failures degrade, never fail — a DOWN router behaves
+    like a DOWN daemon row."""
+    targets = [t for t in (fleet_arg or "").split(",") if t]
+    if len(targets) != 1:
+        return targets
+    try:
+        doc = client.route_status(
+            targets[0],
+            timeout=timeout if timeout is not None
+            else fleet_timeout_s())
+    except Exception:
+        return targets
+    backends = [b.get("target") for b in (doc.get("backends") or [])
+                if b.get("target")]
+    return backends or targets
+
+
 # -- the `racon-tpu metrics` one-shot CLI ------------------------------
 
 
@@ -296,8 +321,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     g.add_argument("--socket",
                    help="unix-domain socket of one daemon")
     g.add_argument("--fleet", metavar="SOCK1,SOCK2,...",
-                   help="comma-separated daemon sockets; output is "
-                   "the merged fleet view")
+                   help="comma-separated daemon sockets, or a single "
+                   "router socket (backends auto-discovered from its "
+                   "route_status); output is the merged fleet view")
     f = p.add_mutually_exclusive_group()
     f.add_argument("--json", action="store_true",
                    help="JSON output (default)")
@@ -328,7 +354,7 @@ def main_metrics(argv=None) -> int:
             print()
         return 0
 
-    targets = [t for t in args.fleet.split(",") if t]
+    targets = resolve_fleet_targets(args.fleet, timeout=timeout)
     scraper = FleetScraper(targets, timeout_s=timeout)
     scraper.scrape_once()
     rows = scraper.results()

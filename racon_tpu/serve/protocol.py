@@ -222,6 +222,34 @@ Staged inputs + straggler rebalancing (r21, racon_tpu/io/staging.py
   ``route_stage_plans`` / ``route_rebalance`` / ``route_cancels``
   counters plus ``route_stage_plan`` / ``route_rebalance`` flight
   events make every plan and handoff auditable.
+
+Closed control loop (r22, racon_tpu/cache/sketch.py +
+racon_tpu/serve/affinity.py + scheduler deadline classes):
+
+* A submission's job spec may carry an optional ``class`` field
+  (``"interactive"`` | ``"batch"``, default ``"interactive"``;
+  client flag ``--class``).  Validated at admission (any other
+  value is ``bad_request``).  The class orders same-priority work
+  (interactive before batch, with an aging bound so batch never
+  starves), scales the job's device-executor DRR weight from the
+  observed per-class queue-wait p99 vs ``RACON_TPU_CLASS_TARGET_
+  P99_S``, and reserves queue headroom for interactive admissions
+  (``RACON_TPU_CLASS_HEADROOM``, scaled up while the SLO is
+  missed).  ``queue_full``/``draining`` rejects price their
+  ``retry_after_s`` from the class's own exec-wall histogram.
+  Scheduling policy only — the class never changes output bytes.
+* A daemon's ``health`` and ``metrics``/``watch`` cache blocks
+  carry ``sketch`` — a compact epoch-tagged digest-membership
+  sketch of the result cache's contents
+  (``{"schema": "racon-tpu-sketch-v1", "m": 65536, "k": 4,
+  "n": ..., "epoch": <engine-epoch hex>, "bits": <base64
+  bitmap>}``, ~11 KiB).  The fleet router scores each
+  content-keyed submit's digest sample against every backend's
+  sketch and folds the estimated hit fraction into placement
+  pricing (``RACON_TPU_ROUTE_AFFINITY``).  Sketch staleness or
+  false positives only mis-price placement — the content-addressed
+  unit keys still decide every actual cache hit, so bytes never
+  depend on the sketch.
 """
 
 from __future__ import annotations

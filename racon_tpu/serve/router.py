@@ -18,6 +18,16 @@ fault-tolerance layer of the ROADMAP's fleet-scale serving item.
   live load + 1) and the r18 hit-ratio discount — and placed on the
   backend with the lowest predicted wall (ties: lowest load, then
   CLI list order, so placement is deterministic under equal load).
+* **Content-affinity placement (r22)** — each submit's content-digest
+  sample (racon_tpu/serve/affinity.py) is scored against every
+  backend's epoch-tagged cache sketch from its health doc
+  (racon_tpu/cache/sketch.py); the per-backend estimated hit
+  fraction feeds the r18 discount, so the backend whose result cache
+  already holds this content wins the pricing outright instead of
+  only breaking near-ties.  Sketch staleness (age-guarded at 3
+  probe periods + timeout) and Bloom false positives only mis-price
+  a placement; bytes are pinned by the cache's full-key lookups.
+  ``RACON_TPU_ROUTE_AFFINITY=0`` disables (pure load/price ranking).
 * **Spillover** — a backend's retryable reject (``queue_full``,
   ``job_too_large``, ``draining``) is not surfaced: the router tries
   the next-best backend, and only when EVERY eligible backend
@@ -108,6 +118,8 @@ breaker state, probe staleness and the counters.
 Knobs (all placement policy — none can change job bytes, so all are
 ``EPOCH_EXCLUDE``'d from cache keys):
 
+* ``RACON_TPU_ROUTE_AFFINITY``           content-affinity placement
+  (1; 0 = pure load/price ranking, no cache-locality preference)
 * ``RACON_TPU_ROUTE_PROBE_S``            probe period (1.0)
 * ``RACON_TPU_ROUTE_PROBE_TIMEOUT_S``    per-probe timeout (2.0)
 * ``RACON_TPU_ROUTE_BREAKER_FAILS``      failures to OPEN (3)
@@ -139,7 +151,7 @@ from racon_tpu.obs import context as obs_context
 from racon_tpu.obs import faultinject
 from racon_tpu.obs import flight as obs_flight
 from racon_tpu.obs import trace as obs_trace
-from racon_tpu.serve import client, protocol, scatter
+from racon_tpu.serve import affinity, client, protocol, scatter
 
 
 def eprint(*args):
@@ -175,6 +187,15 @@ def breaker_fails() -> int:
 def breaker_cooldown_s() -> float:
     return max(0.1,
                _env_float("RACON_TPU_ROUTE_BREAKER_COOLDOWN_S", 5.0))
+
+
+def route_affinity_on() -> bool:
+    """Content-affinity placement (r22): score each submit's content
+    -digest sample against the backends' cache sketches and fold the
+    estimated hit fraction into the placement price.  Default on;
+    "0" also disables the older scalar-hit-ratio tiebreak, leaving
+    pure load/price ranking (the bench's affinity-off arm)."""
+    return os.environ.get("RACON_TPU_ROUTE_AFFINITY", "1") != "0"
 
 
 #: breaker states (route_status renders them uppercase)
@@ -427,17 +448,23 @@ class FleetRouter:
 
     # -- placement -----------------------------------------------------
 
-    def _price(self, spec: dict, concurrency: int):
+    def _price(self, spec: dict, concurrency: int,
+               hit_ratio: float = None):
         """Predicted wall for this job at this backend's load — the
         daemons' own admission model (scheduler.estimate_job ->
         calibrate.predict_walls with shared-wall + hit-ratio terms).
-        None when the inputs cannot be priced from here (e.g. a
+        ``hit_ratio`` is the per-backend sketch-estimated hit
+        fraction (r22) — when given it replaces the router-local
+        trailing ratio in the discount, making the predicted wall
+        backend-specific in cache warmth, not just in load.  None
+        when the inputs cannot be priced from here (e.g. a
         TCP-remote client naming paths this host cannot stat) —
         ranking then falls back to raw load."""
         from racon_tpu.serve import scheduler
         try:
             return scheduler.estimate_job(spec,
-                                          concurrency=concurrency)
+                                          concurrency=concurrency,
+                                          hit_ratio=hit_ratio)
         except (OSError, KeyError, TypeError, ValueError):
             return None
 
@@ -453,25 +480,45 @@ class FleetRouter:
             else:
                 self._placing.pop(target, None)
 
-    @staticmethod
-    def _hit_ratio(backend: Backend) -> float:
+    def _cache_block(self, backend: Backend, now: float) -> dict:
+        """The ``cache`` block of the backend's last good health doc,
+        or {} when that doc is older than the probe staleness window
+        (3 probe periods + the probe timeout — the same bound
+        ``route_status`` reports staleness against).  The age guard
+        is the r22 small fix: a dead backend's last-known hot cache
+        must not keep attracting placements its breaker will only
+        reject later."""
+        health, t = backend.health, backend.t_health
+        if not health or t is None:
+            return {}
+        if now - t > 3 * self.probe_interval + self.probe_timeout:
+            return {}
+        return health.get("cache") or {}
+
+    def _hit_ratio(self, backend: Backend, now: float) -> float:
         """The backend's result-cache hit ratio from its last good
-        health doc (0.0 when it reports no cache block)."""
-        cache = ((backend.health or {}).get("cache") or {})
+        health doc (0.0 when it reports no cache block or the doc is
+        past the staleness window)."""
         try:
-            return float(cache.get("hit_ratio") or 0.0)
+            return float(
+                self._cache_block(backend, now).get("hit_ratio")
+                or 0.0)
         except (TypeError, ValueError):
             return 0.0
 
-    def _affinity_reorder(self, rows: list, tenant: str) -> list:
-        """Cache-locality tiebreak: among backends whose predicted
-        wall is within 10% of the best, prefer the hottest result
-        cache, then one that recently served this tenant's
-        content-keyed jobs.  First-max on ties keeps placement
-        deterministic; unpriceable specs (wall == inf) never
-        reorder — affinity refines the cost model, it never replaces
-        it.  Rows are the pre-sorted ``(wall, load, idx, backend,
-        est)`` tuples."""
+    def _affinity_reorder(self, rows: list, tenant: str,
+                          now: float) -> list:
+        """Scalar cache-locality tiebreak — the pre-r22 fallback used
+        only when no content-digest sample exists for the submit
+        (affinity off handles neither path; sketch pricing in
+        :meth:`_rank` replaces this when a sample is available):
+        among backends whose predicted wall is within 10% of the
+        best, prefer the hottest result cache, then one that
+        recently served this tenant's content-keyed jobs.  First-max
+        on ties keeps placement deterministic; unpriceable specs
+        (wall == inf) never reorder — affinity refines the cost
+        model, it never replaces it.  Rows are the pre-sorted
+        ``(wall, load, idx, backend, est)`` tuples."""
         if len(rows) < 2:
             return rows
         best_wall = rows[0][0]
@@ -485,7 +532,7 @@ class FleetRouter:
                                                  ()))
 
         def warmth(row):
-            return (round(self._hit_ratio(row[3]), 3),
+            return (round(self._hit_ratio(row[3], now), 3),
                     1 if row[3].target in recent else 0)
 
         leader = max(tied, key=warmth)
@@ -495,12 +542,28 @@ class FleetRouter:
         obs_flight.FLIGHT.record(
             "route_cache_affinity", backend=leader[3].target,
             over=rows[0][3].target, tenant=tenant,
-            hit_ratio=self._hit_ratio(leader[3]),
+            hit_ratio=self._hit_ratio(leader[3], now),
             wall_s=(round(leader[0], 4)
                     if leader[0] < float("inf") else None))
         rows.remove(leader)
         rows.insert(0, leader)
         return rows
+
+    def _affinity_sample(self, spec: dict):
+        """(content-digest sample, local engine-epoch hex) for a
+        submit, or ``([], None)`` when affinity routing is off or the
+        sample cannot be derived (unreadable inputs, TCP-remote
+        paths) — ranking then falls back to the scalar tiebreak."""
+        if not route_affinity_on():
+            return [], None
+        try:
+            from racon_tpu.cache import keying
+
+            epoch = keying.engine_epoch()
+            sample = affinity.job_digest_sample(spec, epoch)
+            return sample, epoch.hex()
+        except Exception:
+            return [], None
 
     def _note_tenant_backend(self, tenant: str, job_key: str,
                              target: str) -> None:
@@ -522,8 +585,19 @@ class FleetRouter:
         deterministic under equal load.  Load counts this router's
         own still-in-flight placements on top of the probed depth, so
         K scattered shards planned in one burst spread over the fleet
-        instead of all chasing the same stale-cheapest backend.  Near
-        ties then yield to cache affinity (:meth:`_affinity_reorder`)."""
+        instead of all chasing the same stale-cheapest backend.
+
+        r22 content affinity: when the submit yields a content-digest
+        sample, each backend's price carries ITS OWN estimated hit
+        fraction (sample vs the backend's epoch-tagged cache sketch)
+        as the r18 discount — a warm backend's predicted wall shrinks
+        by up to 90%, so cache locality is priced against load and
+        queue depth in one model instead of breaking near-ties.  A
+        stale or foreign-epoch sketch scores as cold; false positives
+        only under-price.  Without a sample, near ties fall back to
+        the scalar tiebreak (:meth:`_affinity_reorder`)."""
+        sample, epoch_hex = self._affinity_sample(spec)
+        now = obs_trace.now()
         rows = []
         with self._lock:
             placing = dict(self._placing)
@@ -531,7 +605,18 @@ class FleetRouter:
             if backend.target in exclude or not backend.eligible():
                 continue
             load = backend.load() + placing.get(backend.target, 0)
-            est = self._price(spec, load + 1)
+            frac = None
+            if sample:
+                frac = affinity.backend_hit_fraction(
+                    self._cache_block(backend, now).get("sketch"),
+                    sample, epoch_hex)
+            # pass the warmth kwarg only when there is a fraction to
+            # price with -- cold-path calls keep the pre-r22 signature
+            est = (self._price(spec, load + 1, hit_ratio=frac)
+                   if frac is not None
+                   else self._price(spec, load + 1))
+            if est is not None and frac is not None:
+                est["affinity_hit_fraction"] = round(frac, 4)
             wall = None
             if est:
                 wall = est.get("shared_wall_s",
@@ -539,7 +624,16 @@ class FleetRouter:
             rows.append((wall if wall is not None else float("inf"),
                          load, idx, backend, est))
         rows.sort(key=lambda r: (r[0], r[1], r[2]))
-        rows = self._affinity_reorder(rows, tenant)
+        if not sample:
+            rows = self._affinity_reorder(rows, tenant, now)
+        elif rows and (rows[0][4] or {}).get("affinity_hit_fraction"):
+            REGISTRY.add("route_sketch_affinity")
+            obs_flight.FLIGHT.record(
+                "route_sketch_affinity", backend=rows[0][3].target,
+                tenant=tenant,
+                hit_fraction=rows[0][4]["affinity_hit_fraction"],
+                wall_s=(round(rows[0][0], 4)
+                        if rows[0][0] < float("inf") else None))
         return [(backend, est) for _, _, _, backend, est in rows]
 
     # -- submit proxying -----------------------------------------------
@@ -775,10 +869,19 @@ class FleetRouter:
                 slot["done"].set()
 
         def run_attempt(i: int, key: str, pref) -> None:
-            resp = self._route_job(
-                scatter.shard_spec(spec, i, k,
-                                   stage=stage_hints.get(i)),
-                req, key, prefer=pref)
+            try:
+                resp = self._route_job(
+                    scatter.shard_spec(spec, i, k,
+                                       stage=stage_hints.get(i)),
+                    req, key, prefer=pref)
+            except Exception as exc:  # router bug: the attempt fails,
+                # the gather must NOT hang on a slot that can never
+                # settle
+                obs_flight.FLIGHT.record_exception("error", exc)
+                resp = {"ok": False,
+                        "error": {"code": "job_failed",
+                                  "type": type(exc).__name__,
+                                  "reason": str(exc)}}
             settle(i, key, resp)
 
         def launch(i: int, key: str, pref) -> None:

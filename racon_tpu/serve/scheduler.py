@@ -46,6 +46,7 @@ import threading
 from typing import Callable, Optional
 
 from racon_tpu.obs import REGISTRY
+from racon_tpu.obs.metrics import hist_quantile
 from racon_tpu.obs import context as obs_context
 from racon_tpu.obs import decision as obs_decision
 from racon_tpu.obs import faultinject
@@ -94,7 +95,46 @@ def _observed_hit_ratio() -> float:
     return hits / total if total else 0.0
 
 
-def _retry_after_hint_s(pending: int, max_jobs: int) -> float:
+# -- r22 deadline classes ----------------------------------------------
+#: valid values for a submission's ``class`` field; rank orders
+#: same-priority jobs in the queue (lower rank pops first)
+JOB_CLASSES = ("interactive", "batch")
+_CLASS_RANK = {"interactive": 0, "batch": 1}
+
+
+def class_target_p99_s() -> float:
+    """The interactive queue-wait SLO target the class machinery
+    steers toward (seconds).  Policy plane only."""
+    try:
+        return float(os.environ.get("RACON_TPU_CLASS_TARGET_P99_S",
+                                    "2.0"))
+    except ValueError:
+        return 2.0
+
+
+def class_headroom() -> float:
+    """Base fraction of the queue reserved for interactive work when
+    batch admission is throttled (scaled up by observed SLO misses)."""
+    try:
+        return min(0.9, max(0.0, float(os.environ.get(
+            "RACON_TPU_CLASS_HEADROOM", "0.125"))))
+    except ValueError:
+        return 0.125
+
+
+def _class_wait_p99(job_class: str):
+    """Observed queue-wait p99 for one class
+    (``serve_class_wait_s.<class>``), or None before any job of that
+    class has been popped."""
+    h = REGISTRY.snapshot()["histograms"].get(
+        f"serve_class_wait_s.{job_class}")
+    if not h or not h.get("count"):
+        return None
+    return hist_quantile(h, 0.99)
+
+
+def _retry_after_hint_s(pending: int, max_jobs: int,
+                        job_class: str = None) -> float:
     """Server-priced backoff hint for retryable rejects (r19).
 
     The mean observed exec wall (``serve_exec_wall_s``) divided by
@@ -102,21 +142,35 @@ def _retry_after_hint_s(pending: int, max_jobs: int) -> float:
     jobs clear in about ``mean * pending / max_jobs`` seconds.
     Before any job has run the mean is unknown; 1 s stands in.
     Clamped to 0.25..30 s — the hint guides a retry schedule, it is
-    not a promise."""
-    h = REGISTRY.snapshot()["histograms"].get("serve_exec_wall_s")
+    not a promise.  With a ``job_class`` (r22) the hint prices from
+    that class's own exec-wall histogram when it has data — a batch
+    job retrying against a fleet of short interactive jobs should
+    not be told to come back in 250 ms."""
+    hists = REGISTRY.snapshot()["histograms"]
+    h = hists.get(f"serve_class_exec_s.{job_class}") \
+        if job_class else None
+    if not h or not h.get("count"):
+        h = hists.get("serve_exec_wall_s")
     mean = h["sum"] / h["count"] if h and h.get("count") else 1.0
     return round(min(30.0, max(
         0.25, mean * max(1, pending) / max(1, max_jobs))), 3)
 
 
-def estimate_job(spec: dict, concurrency: int = 1) -> dict:
+def estimate_job(spec: dict, concurrency: int = 1,
+                 hit_ratio: float = None) -> dict:
     """Price a submission from input stats alone.
 
     Returns the :func:`calibrate.predict_walls` dict (additive wall,
     overlapped floor, predicted wall — plus ``shared_wall_s`` when
     the job would share the device with ``concurrency - 1`` others)
     plus the raw inputs that produced it, so a reject is auditable
-    from the response."""
+    from the response.
+
+    ``hit_ratio`` overrides the trailing process-wide cache ratio in
+    the r18 discount — the fleet router passes its per-backend
+    sketch-estimated hit fraction here (r22), so the SAME pricing
+    model answers both "can this daemon take the job" and "which
+    daemon's cache already holds this job's units"."""
     from racon_tpu.utils import calibrate
 
     sizes = {}
@@ -148,11 +202,13 @@ def estimate_job(spec: dict, concurrency: int = 1) -> dict:
     # read volume layered over the targets
     align_s = (sizes["sequences"] + overlap_bytes) / mb / align_mbps
     poa_s = (sizes["sequences"] + sizes["targets"]) / mb / poa_mbps
+    if hit_ratio is None:
+        hit_ratio = _observed_hit_ratio()
     est = calibrate.predict_walls(align_s, poa_s,
                                   overlap_s=min(align_s, poa_s),
                                   concurrency=concurrency,
                                   occupancy=_mean_fusion_occupancy(),
-                                  hit_ratio=_observed_hit_ratio())
+                                  hit_ratio=hit_ratio)
     est["input_bytes"] = sizes
     if staged_fraction is not None:
         est["staged_fraction"] = round(staged_fraction, 6)
@@ -166,12 +222,17 @@ class Job:
 
     def __init__(self, job_id: int, spec: dict, priority: int,
                  estimate: dict, tenant: str = "default",
-                 trace_context: str = None):
+                 trace_context: str = None,
+                 job_class: str = "interactive"):
         self.id = job_id
         self.spec = spec
         self.priority = priority
         self.estimate = estimate
         self.tenant = tenant
+        # r22 deadline class: orders same-priority work (interactive
+        # ahead of batch), steers DRR weight and batch admission
+        # headroom — policy only, never bytes
+        self.job_class = job_class
         # durability plane (r17, all None/unset when the journal is
         # off): the idempotence key, the write-ahead journal handle
         # the session's checkpoint callback appends through, the
@@ -221,7 +282,7 @@ class JobScheduler:
         self.max_jobs = max(1, max_jobs if max_jobs is not None
                             else _env_int("RACON_TPU_SERVE_JOBS", 2))
         self._cond = threading.Condition()
-        self._heap: list = []            # (-priority, seq, Job)
+        self._heap: list = []   # (-priority, class_rank, seq, Job)
         self._seq = itertools.count()
         self._ids = itertools.count(1)
         self._running: dict = {}         # job_id -> Job
@@ -236,6 +297,12 @@ class JobScheduler:
         self._draining = False
         self._stopped = False
         self._completed = 0
+        # r22 drift-triggered recalibration: job boundaries left
+        # before drift flags may open another epoch (the calhealth
+        # registry gauge keeps its stale value until the first
+        # post-recalibration observation, so reopening immediately
+        # would re-trigger on old data)
+        self._drift_cooldown = 0
         self._workers = [
             threading.Thread(target=self._worker_loop, daemon=True,
                              name=f"racon-serve-worker-{i}")
@@ -367,6 +434,15 @@ class JobScheduler:
                 "code": "bad_request",
                 "reason": "tenant must be a non-empty string "
                           "of at most 64 characters"})
+        # r22 deadline class: optional, validated at admission.  The
+        # class rides the spec, so routed scatter sub-jobs inherit
+        # the mega-job's class like they inherit tenant/priority.
+        job_class = spec.get("class", "interactive")
+        if job_class not in JOB_CLASSES:
+            raise RejectError({
+                "code": "bad_request",
+                "reason": "class must be one of "
+                          + "/".join(JOB_CLASSES)})
         # r20 scatter: a routed sub-job carries its target shard as
         # spec["shard"] = [index, count] (tenant/priority already ride
         # the spec/frame, so a shard inherits both from the mega-job).
@@ -423,7 +499,7 @@ class JobScheduler:
                               "finish, new jobs are rejected",
                     "retry_after_s": _retry_after_hint_s(
                         len(self._heap) + len(self._running),
-                        self.max_jobs)})
+                        self.max_jobs, job_class=job_class)})
             if len(self._heap) >= self.max_queue:
                 REGISTRY.add("serve_reject.queue_full")
                 raise RejectError({
@@ -434,7 +510,27 @@ class JobScheduler:
                     "running": len(self._running),
                     # one slot must free before a retry can admit
                     "retry_after_s": _retry_after_hint_s(
-                        1, self.max_jobs)})
+                        1, self.max_jobs, job_class=job_class)})
+            if job_class == "batch":
+                # r22 SLO-driven admission headroom: the queue's tail
+                # slots are reserved for interactive work, and the
+                # reservation GROWS while the observed interactive
+                # queue-wait p99 misses its target — admission derives
+                # from measured SLO attainment, not static priority
+                reserve = self._batch_reserved_slots()
+                if reserve and \
+                        len(self._heap) >= self.max_queue - reserve:
+                    REGISTRY.add("serve_reject.class_headroom")
+                    raise RejectError({
+                        "code": "queue_full",
+                        "reason": "queue headroom reserved for "
+                                  "interactive class; retry later",
+                        "queue_depth": len(self._heap),
+                        "max_queue": self.max_queue,
+                        "reserved_slots": reserve,
+                        "running": len(self._running),
+                        "retry_after_s": _retry_after_hint_s(
+                            1, self.max_jobs, job_class=job_class)})
             if job_key is not None:
                 # re-check under the admission lock: two concurrent
                 # NEW submits with the same key must admit once
@@ -447,7 +543,8 @@ class JobScheduler:
                         recorded=hit.done.is_set())
                     return hit
             job = Job(next(self._ids), spec, priority, estimate,
-                      tenant=tenant, trace_context=trace_context)
+                      tenant=tenant, trace_context=trace_context,
+                      job_class=job_class)
             job.t_submit = obs_trace.now()
             job.resume = resume
             job.recovered_from = recovered_from
@@ -479,8 +576,9 @@ class JobScheduler:
             if job.job_key:
                 self._by_key[job.job_key] = job
             faultinject.hit("post-admit")
-            heapq.heappush(self._heap, (-priority, next(self._seq),
-                                        job))
+            heapq.heappush(self._heap,
+                           (-priority, _CLASS_RANK[job_class],
+                            next(self._seq), job))
             REGISTRY.add("serve_jobs_submitted")
             REGISTRY.add("serve_admit")
             REGISTRY.peak("serve_queue_high_water", len(self._heap))
@@ -494,7 +592,7 @@ class JobScheduler:
             obs_flight.FLIGHT.record(
                 "admit", job=job.id, tenant=tenant,
                 trace_id=job.trace_id,
-                priority=priority,
+                priority=priority, job_class=job_class,
                 shard=(list(shard) if shard is not None else None),
                 predicted_wall_s=round(
                     estimate.get("predicted_wall_s", 0.0), 4),
@@ -504,6 +602,76 @@ class JobScheduler:
                 queue_depth=len(self._heap))
             self._cond.notify()
             return job
+
+    # -- r22 deadline-class policy -------------------------------------
+
+    #: a queued batch job older than this many interactive p99
+    #: targets jumps the class ordering — the starvation bound
+    CLASS_STARVATION_FACTOR = 4.0
+
+    def _batch_reserved_slots(self) -> int:
+        """Queue slots reserved for interactive admissions while
+        batch is throttled.  The base reservation is
+        ``RACON_TPU_CLASS_HEADROOM`` of the queue; while the observed
+        interactive queue-wait p99 exceeds
+        ``RACON_TPU_CLASS_TARGET_P99_S`` the reservation scales with
+        the miss ratio (capped at half the queue) — measured SLO
+        attainment drives admission, not static priority."""
+        frac = class_headroom()
+        if frac <= 0.0:
+            return 0
+        target = class_target_p99_s()
+        p99 = _class_wait_p99("interactive")
+        if target > 0 and p99 is not None and p99 > target:
+            frac = min(0.5, frac * min(4.0, p99 / target))
+        return min(self.max_queue - 1,
+                   int(self.max_queue * frac + 0.5))
+
+    def _class_weight(self, job) -> float:
+        """DRR weight for a job's executor tenancy, derived from
+        observed per-class SLO attainment (r22) instead of static
+        priority alone.  Interactive work always carries at least 2x
+        batch weight; when its observed queue-wait p99 misses the
+        target, the weight scales with the miss ratio (capped 8x) so
+        the executor's deficit-round-robin leans harder toward the
+        class that is actually late.  Priority still floors the
+        weight, so explicit priorities keep meaning."""
+        base = max(1.0, 1.0 + job.priority)
+        if job.job_class != "interactive":
+            return base
+        target = class_target_p99_s()
+        p99 = _class_wait_p99("interactive")
+        if target <= 0 or p99 is None:
+            return max(base, 2.0)
+        return max(base, min(8.0, 2.0 * max(1.0, p99 / target)))
+
+    def _pop_next_job(self):
+        """Pop the next job honoring the class order with a
+        starvation bound: normally strict heap order (priority, then
+        interactive-before-batch, then FIFO), but a batch job queued
+        longer than CLASS_STARVATION_FACTOR x the interactive p99
+        target jumps ahead of an interactive head — so a steady
+        interactive stream can delay batch work only boundedly.
+        Called under the lock with a non-empty heap."""
+        head = self._heap[0][-1]
+        bound = self.CLASS_STARVATION_FACTOR * class_target_p99_s()
+        if head.job_class == "interactive" and bound > 0:
+            now = obs_trace.now()
+            aged = [e for e in self._heap
+                    if e[-1].job_class == "batch"
+                    and e[-1].t_submit is not None
+                    and now - e[-1].t_submit > bound]
+            if aged:
+                entry = min(aged, key=lambda e: e[-1].t_submit)
+                self._heap.remove(entry)
+                heapq.heapify(self._heap)
+                REGISTRY.add("serve_class_aged_pops")
+                obs_flight.FLIGHT.record(
+                    "class_age_pop", job=entry[-1].id,
+                    tenant=entry[-1].tenant,
+                    waited_s=round(now - entry[-1].t_submit, 3))
+                return entry[-1]
+        return heapq.heappop(self._heap)[-1]
 
     # -- workers -------------------------------------------------------
 
@@ -520,7 +688,7 @@ class JobScheduler:
                     self._cond.wait()
                 if self._stopped:
                     return
-                _, _, job = heapq.heappop(self._heap)
+                job = self._pop_next_job()
                 self._running[job.id] = job
                 REGISTRY.set("serve_queue_depth", len(self._heap))
                 REGISTRY.set("serve_running", len(self._running))
@@ -534,6 +702,8 @@ class JobScheduler:
                 REGISTRY.observe("serve_queue_wait_s", queue_wait)
                 REGISTRY.observe(
                     f"serve_queue_wait_s.{job.tenant}", queue_wait)
+                REGISTRY.observe(
+                    f"serve_class_wait_s.{job.job_class}", queue_wait)
             obs_flight.FLIGHT.record(
                 "start", job=job.id, tenant=job.tenant,
                 trace_id=job.trace_id,
@@ -562,7 +732,7 @@ class JobScheduler:
 
                 ex = device_executor.get_executor()
                 ex.register_tenant(job.tenant,
-                                   weight=max(1.0, 1.0 + job.priority))
+                                   weight=self._class_weight(job))
                 # the job context makes everything recorded during
                 # this job's execution — spans, flight events, log
                 # lines — attributable to (job, tenant) with no
@@ -594,6 +764,8 @@ class JobScheduler:
                 ok=bool(result.get("ok")),
                 exec_wall_s=round(exec_wall, 6))
             REGISTRY.observe("serve_exec_wall_s", exec_wall)
+            REGISTRY.observe(
+                f"serve_class_exec_s.{job.job_class}", exec_wall)
             if job.t_submit is not None:
                 REGISTRY.observe("serve_e2e_wall_s",
                                  t_done - job.t_submit)
@@ -614,6 +786,17 @@ class JobScheduler:
                     predicted_s=round(float(predicted), 6),
                     measured_s=round(exec_wall, 6),
                     ratio=round(exec_wall / predicted, 6))
+            if result.get("ok"):
+                # r22 content affinity: this job's content is warm in
+                # the local result cache now — note its digest sample
+                # into the sketch the fleet router prices against
+                from racon_tpu.serve import affinity
+
+                affinity.note_job_content(job.spec)
+            # r22 drift-triggered recalibration: a job boundary is
+            # the only place a new calibration epoch may open (jobs
+            # in flight keep their r17 pinned rates)
+            self._drift_epoch_tick()
             # terminal record BEFORE the client rendezvous: once the
             # caller sees the result, any crash must replay it from
             # the journal, not re-run the job
@@ -636,6 +819,58 @@ class JobScheduler:
                 REGISTRY.set("serve_running", len(self._running))
                 self._cond.notify_all()
             job.finish(result)
+
+    #: job boundaries to wait after a drift epoch closes before
+    #: drift flags may open another one
+    DRIFT_REOPEN_COOLDOWN = 5
+
+    def _drift_epoch_tick(self) -> None:
+        """r22 drift-triggered recalibration, called once per job
+        boundary from the worker loop.  When any calhealth stage's
+        EWMA drift ratio has left the advisory band, open a
+        calibration epoch (calibrate.open_drift_epoch lifts the
+        serve-mode freeze for one two-pass recalibration); while an
+        epoch is open, count boundaries until it closes.  Policy
+        plane only: new rates affect pricing/pacing of jobs admitted
+        AFTER they persist — in-flight jobs keep their r17 pinned
+        epoch snapshot, so bytes never drift within a job."""
+        from racon_tpu.utils import calibrate
+
+        try:
+            if not calibrate.drift_epoch_enabled():
+                return
+            if calibrate.drift_epoch_state()["open"]:
+                if calibrate.note_drift_job():
+                    # epoch just closed: freeze re-arms, start the
+                    # reopen cooldown so the stale EWMA gauge can't
+                    # immediately re-trigger
+                    self._drift_cooldown = self.DRIFT_REOPEN_COOLDOWN
+                    obs_flight.FLIGHT.record("calib_drift_epoch",
+                                             state="closed")
+                return
+            if self._drift_cooldown > 0:
+                self._drift_cooldown -= 1
+                return
+            from racon_tpu.obs import calhealth
+
+            drifted = sorted(
+                stage for stage, row in
+                calhealth.summary().get("stages", {}).items()
+                if row.get("drift"))
+            if not drifted:
+                return
+            if calibrate.open_drift_epoch():
+                for stage in drifted:
+                    # re-seed the EWMA so the drift flag measures the
+                    # NEW rates instead of averaging across the epoch
+                    calhealth.reset_stage(stage)
+                REGISTRY.add("calib_drift_epochs")
+                obs_flight.FLIGHT.record("calib_drift_epoch",
+                                         state="open", stages=drifted)
+        except Exception:
+            # drift bookkeeping is advisory — never fail a job
+            # boundary on it
+            pass
 
     # -- cancellation (r21) --------------------------------------------
 
@@ -722,14 +957,19 @@ class JobScheduler:
     def snapshot(self) -> dict:
         with self._cond:
             tenants: dict = {}
-            for _, _, job in self._heap:
+            classes = {c: {"queued": 0, "running": 0}
+                       for c in JOB_CLASSES}
+            for entry in self._heap:
+                job = entry[-1]
                 row = tenants.setdefault(
                     job.tenant, {"queued": 0, "running": 0})
                 row["queued"] += 1
+                classes[job.job_class]["queued"] += 1
             for job in self._running.values():
                 row = tenants.setdefault(
                     job.tenant, {"queued": 0, "running": 0})
                 row["running"] += 1
+                classes[job.job_class]["running"] += 1
             return {
                 "queue_depth": len(self._heap),
                 "max_queue": self.max_queue,
@@ -739,4 +979,5 @@ class JobScheduler:
                 "paused": self._paused,
                 "draining": self._draining,
                 "tenants": {t: tenants[t] for t in sorted(tenants)},
+                "classes": classes,
             }

@@ -210,7 +210,8 @@ class PolishServer:
             "queue": self.scheduler.snapshot(),
             "device_util": du,
             "fusion": device_executor.get_executor().stats(),
-            "cache": rcache.stats(),
+            "cache": dict(rcache.stats(),
+                          sketch=self._cache_health().get("sketch")),
             "slo": export.slo_summary(snap),
             "calhealth": export.drift_summary(snap),
             "snapshot": export.json_snapshot(snap),
@@ -303,14 +304,24 @@ class PolishServer:
 
     def _cache_health(self) -> dict:
         """The result cache's cheap health block (r18): hit ratio +
-        resident bytes, without the full stats walk."""
+        resident bytes, without the full stats walk.  r22 attaches
+        the epoch-tagged digest sketch (racon_tpu/cache/sketch.py) —
+        ~11 KiB base64 — which the fleet router scores content-keyed
+        submits against for affinity placement."""
         from racon_tpu import cache as rcache
 
         st = rcache.stats()
-        return {"enabled": st.get("enabled", False),
-                "hit_ratio": st.get("hit_ratio", 0.0),
-                "bytes": st.get("bytes", 0),
-                "entries": st.get("entries", 0)}
+        doc = {"enabled": st.get("enabled", False),
+               "hit_ratio": st.get("hit_ratio", 0.0),
+               "bytes": st.get("bytes", 0),
+               "entries": st.get("entries", 0)}
+        try:
+            doc["sketch"] = rcache.sketch_doc()
+        except Exception:
+            # sketch export is advisory routing data; never let it
+            # break a health probe
+            doc["sketch"] = None
+        return doc
 
     def _journal_doc(self) -> dict:
         """The write-ahead journal's health block (r17)."""

@@ -264,8 +264,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     g.add_argument("--socket",
                    help="unix-domain socket of the server to watch")
     g.add_argument("--fleet", metavar="SOCK1,SOCK2,...",
-                   help="comma-separated daemon sockets; renders "
-                   "per-daemon rows + the merged fleet SLO table")
+                   help="comma-separated daemon sockets, or a single "
+                   "router socket (backends auto-discovered from its "
+                   "route_status); renders per-daemon rows + the "
+                   "merged fleet SLO table")
     p.add_argument("--interval", type=float, default=1.0,
                    help="refresh period in seconds (default 1.0)")
     p.add_argument("--count", type=int, default=0,
@@ -282,7 +284,7 @@ def _main_fleet(args, count: int) -> int:
     from racon_tpu.serve import fleet
 
     scraper = fleet.FleetScraper(
-        [t for t in args.fleet.split(",") if t])
+        fleet.resolve_fleet_targets(args.fleet))
     live = sys.stdout.isatty() and not args.json and count != 1
     sent = 0
     try:

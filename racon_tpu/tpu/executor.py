@@ -61,6 +61,17 @@ tenants: an at-quota tenant's units are held back while any other
 tenant has pending work (the quota is work-conserving -- alone, a
 tenant runs unthrottled).
 
+With ``RACON_TPU_FUSE_ADAPT=1`` (r22, default off) the dispatcher
+tunes the window online from observed batch occupancy: an occupancy
+EWMA below ~0.55 (batches dispatching underfilled at window expiry)
+grows the wait multiplicatively, above ~0.9 (batches filling before
+the window binds) shrinks it, always clamped to
+[0, ``RACON_TPU_FUSE_WAIT_MS``] with a dead-band hysteresis between.
+The current value exports as the ``fusion_wait_ms`` gauge.  The
+window is pure policy — it decides WHEN a bucket dispatches, never
+what the fused batch computes — so output bytes are identical with
+adaptation on or off.
+
 Single-tenant degradation
 -------------------------
 With fusion disabled (``RACON_TPU_FUSE=0``) or fewer than two
@@ -131,6 +142,25 @@ def fuse_forced() -> bool:
 
 def fuse_wait_s() -> float:
     return max(0.0, _env_float("RACON_TPU_FUSE_WAIT_MS", 5.0)) / 1e3
+
+
+def fuse_adapt_on() -> bool:
+    """Online fusion-window tuning (r22): the dispatcher adjusts its
+    fuse wait between 0 and ``RACON_TPU_FUSE_WAIT_MS`` from observed
+    batch occupancy.  Policy plane only — the window decides WHEN a
+    bucket dispatches, never what the fused batch computes, so bytes
+    stay pinned with the knob on or off."""
+    return os.environ.get("RACON_TPU_FUSE_ADAPT", "0") == "1"
+
+
+#: adaptive-window controller constants: EMA smoothing, the
+#: occupancy dead band (hysteresis — no adjustment inside it), the
+#: multiplicative step sizes, and dispatches between adjustments
+_ADAPT_ALPHA = 0.3
+_ADAPT_BAND = (0.55, 0.9)
+_ADAPT_UP = 1.25
+_ADAPT_DOWN = 0.8
+_ADAPT_EVERY = 4
 
 
 def tenant_quota() -> int:
@@ -310,6 +340,12 @@ class DeviceExecutor:
         self._dispatcher = None
         self._shutdown = False
         self._own_pool = None
+        # r22 adaptive fusion window: current wait (None = seed from
+        # the env ceiling on first use), occupancy EMA, dispatches
+        # since the last adjustment
+        self._adapt_wait_s = None
+        self._adapt_occ = None
+        self._adapt_since = 0
 
     # -- tenancy ------------------------------------------------------------
     def register_tenant(self, name: str, weight: float = 1.0):
@@ -777,12 +813,57 @@ class DeviceExecutor:
                     self._inflight.get(u.tenant, 0) + 1)
         return picked, total, target
 
+    def _current_fuse_wait_s(self) -> float:
+        """The fuse window in effect: the env ceiling, or (adaptive
+        mode, r22) the controller's current value clamped to
+        [0, ceiling] — so adaptive mode can never hold a unit longer
+        than the static configuration would."""
+        ceil = fuse_wait_s()
+        if not fuse_adapt_on():
+            return ceil
+        w = self._adapt_wait_s
+        if w is None:
+            self._adapt_wait_s = w = ceil
+        return min(max(0.0, w), ceil)
+
+    def _adapt_tick(self, occupancy: float) -> None:
+        """Fold one dispatch's occupancy into the adaptive window.
+        An occupancy EMA below the dead band means batches dispatch
+        underfilled at window expiry — earn a longer wait (more time
+        for batchmates); above the band, batches fill before the
+        window binds — earn a shorter one and stop paying the window
+        in queue latency.  Inside the band: hold (hysteresis).  Runs
+        on the dispatcher thread only; clocks feed the wait DURATION,
+        a policy input — never batch contents."""
+        ceil = fuse_wait_s()
+        if not fuse_adapt_on() or ceil <= 0.0:
+            return
+        prev = self._adapt_occ
+        self._adapt_occ = occupancy if prev is None else \
+            prev + _ADAPT_ALPHA * (occupancy - prev)
+        self._adapt_since += 1
+        if self._adapt_since < _ADAPT_EVERY:
+            return
+        self._adapt_since = 0
+        w = self._adapt_wait_s if self._adapt_wait_s is not None \
+            else ceil
+        if self._adapt_occ < _ADAPT_BAND[0]:
+            # a zero window still re-opens: step from a 2% floor
+            w = min(ceil, max(w, 0.02 * ceil) * _ADAPT_UP)
+        elif self._adapt_occ > _ADAPT_BAND[1]:
+            w = w * _ADAPT_DOWN
+        else:
+            return
+        self._adapt_wait_s = min(max(0.0, w), ceil)
+        REGISTRY.set("fusion_wait_ms",
+                     round(self._adapt_wait_s * 1e3, 4))
+
     def _bucket_ripe(self, key, now) -> bool:
         units = self._buckets.get(key)
         if not units:
             return False
         head = min(u.t_submit for u in units)
-        if now - head >= fuse_wait_s():
+        if now - head >= self._current_fuse_wait_s():
             return True
         target = self._occupancy_target(units)
         if target and sum(u.size for u in units) >= target:
@@ -806,8 +887,8 @@ class DeviceExecutor:
                 if not ripe:
                     heads = [min(u.t_submit for u in us)
                              for us in self._buckets.values() if us]
-                    wait = (min(heads) + fuse_wait_s() - now) \
-                        if heads else 0.05
+                    wait = (min(heads) + self._current_fuse_wait_s()
+                            - now) if heads else 0.05
                     self._cond.wait(max(1e-4, min(wait, 0.05)))
                     continue
                 key = min(ripe, key=lambda k: min(
@@ -837,6 +918,7 @@ class DeviceExecutor:
                 REGISTRY.add("fused_cross_tenant")
         occupancy = total / target if target else 1.0
         REGISTRY.observe("fusion_occupancy", occupancy)
+        self._adapt_tick(occupancy)
         try:
             collect, n_items = units[0].fuse_dispatch(
                 units, self._pool())
@@ -920,7 +1002,9 @@ class DeviceExecutor:
                 "pending_units": self._n_pending,
                 "pending_items": pending,
                 "quota": tenant_quota(),
-                "fuse_wait_ms": fuse_wait_s() * 1e3,
+                "fuse_wait_ms": self._current_fuse_wait_s() * 1e3,
+                "fuse_wait_ceiling_ms": fuse_wait_s() * 1e3,
+                "fuse_adapt": fuse_adapt_on(),
             }
         for key in ("fusion_dispatches", "fusion_units_fused",
                     "fused_megabatches", "fused_cross_tenant"):

@@ -245,6 +245,70 @@ def predict_walls(align_s: float, poa_s: float,
     return out
 
 
+# -- drift-triggered recalibration epochs (r22) ------------------------
+#
+# A serving daemon pins calibration for its lifetime
+# (RACON_TPU_CALIB_FREEZE, set by serve_forever) so served bytes
+# match a CLI run at server-start calibration state.  When the
+# calhealth EWMA says the pinned rates price a stage badly, the
+# scheduler may OPEN a drift epoch (RACON_TPU_CALIB_DRIFT_EPOCH=1)
+# at a job boundary: the freeze lifts for a two-pass recalibration
+# (first store per stage overwrites like RACON_TPU_RECALIBRATE,
+# second converges it, then the normal gen>=2 freeze re-arms), after
+# which the epoch closes and the daemon is pinned again — at the new
+# epoch.  Jobs admitted before the epoch opened keep their r17
+# per-job calibration pins, so rates never change under a running
+# job and bytes never drift within one.
+
+_drift = {"open": False, "jobs": 0, "fresh": set()}
+
+#: job boundaries a drift epoch stays open for — the two-pass
+#: settle of store_rates, measured in jobs
+DRIFT_EPOCH_JOBS = 2
+
+
+def drift_epoch_enabled() -> bool:
+    return os.environ.get("RACON_TPU_CALIB_DRIFT_EPOCH", "0") == "1"
+
+
+def open_drift_epoch() -> bool:
+    """Open a recalibration epoch (idempotent).  Returns True when
+    this call opened it."""
+    with _lock:
+        if _drift["open"]:
+            return False
+        _drift["open"] = True
+        _drift["jobs"] = 0
+        _drift["fresh"] = set()
+        return True
+
+
+def note_drift_job() -> bool:
+    """Count one finished job against the open epoch; the epoch
+    closes after :data:`DRIFT_EPOCH_JOBS` boundaries.  Returns True
+    when this call closed it."""
+    with _lock:
+        if not _drift["open"]:
+            return False
+        _drift["jobs"] += 1
+        if _drift["jobs"] >= DRIFT_EPOCH_JOBS:
+            _drift["open"] = False
+            return True
+        return False
+
+
+def drift_epoch_state() -> dict:
+    with _lock:
+        return {"open": _drift["open"], "jobs": _drift["jobs"]}
+
+
+def _reset_drift_for_tests() -> None:
+    with _lock:
+        _drift["open"] = False
+        _drift["jobs"] = 0
+        _drift["fresh"] = set()
+
+
 #: device-rate unit scale per stage: ``store_rates`` persists "poa"
 #: as us/cost-unit and the align stages as ns/unit (row / e-step), so
 #: inverting a rate back into a predicted wall needs the matching
@@ -289,10 +353,13 @@ def store_rates(stage: str, n_dev: int, dev_rate: float,
     raises."""
     if not dev_rate > 0 or (cpu_rate is not None and not cpu_rate > 0):
         return
-    if os.environ.get("RACON_TPU_CALIB_FREEZE"):
+    if os.environ.get("RACON_TPU_CALIB_FREEZE") \
+            and not _drift["open"]:
         # serve mode: a served job's bytes must match a standalone
         # CLI run at server-start calibration state, so jobs read
-        # rates but never store them (racon_tpu/serve/server.py)
+        # rates but never store them (racon_tpu/serve/server.py) —
+        # unless an r22 drift epoch is open, which lifts the freeze
+        # for exactly one two-pass recalibration
         return
     try:
         path = _calib_path()
@@ -309,13 +376,25 @@ def store_rates(stage: str, n_dev: int, dev_rate: float,
             ent = data.setdefault(mkey, {})
             old = ent.get(stage)
             recal = os.environ.get("RACON_TPU_RECALIBRATE")
+            drift_restart = False
+            if _drift["open"] and stage not in _drift["fresh"]:
+                # drift epoch (r22): the first store per stage
+                # overwrites the frozen entry and restarts its
+                # two-pass sequence, exactly like RECALIBRATE
+                recal = True
+                drift_restart = True
+                _drift["fresh"].add(stage)
             old_real = old and not old.get("provisional")
             if old_real and old.get("gen", 1) >= 2 and not recal:
                 return
             if provisional and old_real and not recal:
                 # a low-confidence sample must not degrade a real one
                 return
-            if provisional:
+            if provisional or drift_restart:
+                # provisional: never freezes.  drift_restart: the new
+                # epoch's own two-pass sequence begins at generation
+                # 1, so the second pass converges it and the freeze
+                # re-arms at gen 2
                 gen = 1
             else:
                 # a real sample after provisional ones starts its own

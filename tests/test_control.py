@@ -1,0 +1,565 @@
+"""Closed control loop (ISSUE r22): the four adaptive layers.
+
+Every layer here is POLICY — placement, pacing, admission ordering,
+recalibration timing — wrapped around the byte-determinism contract,
+so each test pins two things: the controller moves the decision it
+owns, and no decision it makes can move output bytes.
+
+* **cache-content routing** (racon_tpu/cache/sketch.py,
+  racon_tpu/serve/affinity.py, router._rank): the sketch is a lossy
+  warmth estimate; a poisoned (all-ones) sketch mis-ROUTES, but the
+  result cache still verifies every lookup by full key, so nothing
+  false is ever served.  Stale health docs age out of pricing (the
+  r22 `_hit_ratio`/`_cache_block` guard).
+* **adaptive fusion window** (tpu/executor.py): occupancy-EMA
+  controller, bounded [0, RACON_TPU_FUSE_WAIT_MS], dead-band
+  hysteresis; clocks feed only the WAIT — on/off byte identity.
+* **deadline classes** (serve/scheduler.py): admission validation,
+  interactive-before-batch ordering, the aged-batch starvation bound
+  and the SLO-scaled batch admission headroom.
+* **drift-triggered recalibration epochs** (utils/calibrate.py +
+  scheduler._drift_epoch_tick): the serve freeze lifts for exactly
+  one two-pass recalibration at a job boundary, jobs in flight keep
+  their r17 pinned snapshot, and a reopen cooldown covers the stale
+  calhealth gauge.
+"""
+
+import base64
+import hashlib
+import time
+
+import pytest
+
+from racon_tpu.cache import keying, sketch
+from racon_tpu.cache.store import MISS, ResultCache
+from racon_tpu.obs import calhealth
+from racon_tpu.obs import trace as obs_trace
+from racon_tpu.obs.metrics import REGISTRY
+from racon_tpu.serve import affinity, fleet, router
+from racon_tpu.serve import scheduler as sched_mod
+from racon_tpu.serve.scheduler import JobScheduler, RejectError
+from racon_tpu.tpu import executor as ex_mod
+from racon_tpu.utils import calibrate
+
+
+def _digest(tag: bytes) -> bytes:
+    return hashlib.blake2b(tag, digest_size=32).digest()
+
+
+def _counter(name: str) -> int:
+    return int(REGISTRY.snapshot().get("counters", {}).get(name, 0))
+
+
+# ---------------------------------------------------------------------------
+# layer 1a: the digest sketch itself
+# ---------------------------------------------------------------------------
+
+def test_sketch_membership_export_and_hit_fraction():
+    sk = sketch.DigestSketch()
+    present = [_digest(b"in-%d" % i) for i in range(64)]
+    absent = [_digest(b"out-%d" % i) for i in range(64)]
+    for d in present:
+        sk.add(d)
+    assert all(d in sk for d in present)
+    # at 64/65536 load false positives are ~0 — absent keys miss
+    assert not any(d in sk for d in absent)
+
+    doc = sk.export("aa" * 16, len(present))
+    assert doc["schema"] == sketch.SKETCH_SCHEMA
+    assert doc["n"] == 64 and doc["epoch"] == "aa" * 16
+    bits = sketch.decode_bits(doc)
+    assert bits is not None and len(bits) == sketch.M // 8
+    assert all(sketch.bits_contain(bits, d) for d in present)
+    assert sketch.hit_fraction(doc, present) == 1.0
+    assert sketch.hit_fraction(doc, absent) == 0.0
+    assert sketch.hit_fraction(doc, present + absent) == 0.5
+
+    # discard keeps the filter honest under eviction churn
+    for d in present[:32]:
+        sk.discard(d)
+    assert not any(d in sk for d in present[:32])
+    assert all(d in sk for d in present[32:])
+
+
+def test_sketch_saturated_counters_stick():
+    sk = sketch.DigestSketch()
+    d = _digest(b"hot")
+    for _ in range(300):            # push every slot to 255
+        sk.add(d)
+    for _ in range(300):
+        sk.discard(d)
+    # a saturated counter never decrements: membership over-reports
+    # (placement mis-pricing) instead of under-reporting another
+    # key's slots into absence
+    assert d in sk
+
+
+def test_sketch_rejects_foreign_docs():
+    for bad in (None, 7, {}, {"schema": "other", "m": sketch.M,
+                             "k": sketch.K, "bits": ""},
+                {"schema": sketch.SKETCH_SCHEMA, "m": 16,
+                 "k": sketch.K, "bits": ""},
+                {"schema": sketch.SKETCH_SCHEMA, "m": sketch.M,
+                 "k": sketch.K, "bits": "!!not-base64!!"},
+                {"schema": sketch.SKETCH_SCHEMA, "m": sketch.M,
+                 "k": sketch.K,
+                 "bits": base64.b64encode(b"x").decode()}):
+        assert sketch.decode_bits(bad) is None
+        assert sketch.hit_fraction(bad, [_digest(b"d")]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# layer 1b: job-level content digests
+# ---------------------------------------------------------------------------
+
+def _tiny_spec(tmp_path):
+    reads = tmp_path / "r.fasta"
+    reads.write_text(">r1\nACGTACGTACGT\n")
+    paf = tmp_path / "o.paf"
+    paf.write_text("r1\t12\t0\t12\t+\tt1\t12\t0\t12\t12\t12\t255\n")
+    draft = tmp_path / "t.fasta"
+    draft.write_text(">t1\nACGTACGTACGT\n")
+    return {"sequences": str(reads), "overlaps": str(paf),
+            "targets": str(draft)}
+
+
+def test_affinity_sample_deterministic_and_epoch_folded(tmp_path):
+    spec = _tiny_spec(tmp_path)
+    a = affinity.job_digest_sample(spec, epoch=b"\x01" * 16)
+    b = affinity.job_digest_sample(spec, epoch=b"\x01" * 16)
+    assert a and a == b                  # deterministic in the spec
+    c = affinity.job_digest_sample(spec, epoch=b"\x02" * 16)
+    # a different engine epoch yields disjoint digests: membership in
+    # a foreign-environment sketch fails closed
+    assert not set(a) & set(c)
+    # shard mask folds too — a shard's units are not the full job's
+    d = affinity.job_digest_sample(dict(spec, shard=[1, 4]),
+                                   epoch=b"\x01" * 16)
+    assert not set(a) & set(d)
+
+    doc = {"schema": sketch.SKETCH_SCHEMA, "m": sketch.M,
+           "k": sketch.K, "n": 1, "epoch": "aa" * 16,
+           "bits": base64.b64encode(b"\xff" * (sketch.M // 8))
+           .decode()}
+    # epoch-tagged sketch from another environment: no usable answer
+    assert affinity.backend_hit_fraction(doc, a, "bb" * 16) is None
+    assert affinity.backend_hit_fraction(doc, a, "aa" * 16) == 1.0
+    assert affinity.backend_hit_fraction(None, a, "aa" * 16) is None
+    assert affinity.backend_hit_fraction(doc, [], "aa" * 16) is None
+
+
+def _priced_spec(tmp_path):
+    """Inputs big enough that predict_walls (3-decimal rounding)
+    prices a nonzero wall — the sketch discount must be able to move
+    the number."""
+    seq = "ACGT" * 50_000
+    reads = tmp_path / "r.fasta"
+    reads.write_text(">r1\n" + seq + "\n")
+    paf = tmp_path / "o.paf"
+    paf.write_text(
+        "r1\t12\t0\t12\t+\tt1\t12\t0\t12\t12\t12\t255\n" * 2000)
+    draft = tmp_path / "t.fasta"
+    draft.write_text(">t1\n" + seq + "\n")
+    return {"sequences": str(reads), "overlaps": str(paf),
+            "targets": str(draft)}
+
+
+# ---------------------------------------------------------------------------
+# layer 1c: router pricing against sketches
+# ---------------------------------------------------------------------------
+
+def _poisoned_sketch(epoch_hex: str) -> dict:
+    """A sketch claiming EVERY digest — the worst-case false-positive
+    cloud (all 65536 projected bits set)."""
+    return {"schema": sketch.SKETCH_SCHEMA, "m": sketch.M,
+            "k": sketch.K, "n": 10_000, "epoch": epoch_hex,
+            "bits": base64.b64encode(b"\xff" * (sketch.M // 8))
+            .decode()}
+
+
+def test_poisoned_sketch_misroutes_but_never_serves_bytes(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("RACON_TPU_ROUTE_AFFINITY", "1")
+    spec = _priced_spec(tmp_path)
+    r = router.FleetRouter(str(tmp_path / "r.sock"),
+                           ["a.sock", "b.sock"])
+    now = obs_trace.now()            # _rank checks sketch age against
+    healthy = {"ok": True, "status": "ok",   # the REAL clock
+               "accepting": True, "queue_depth": 0, "running": 0}
+    epoch_hex = keying.engine_epoch().hex()
+    r.backends[0].note_success(dict(healthy), now)
+    r.backends[1].note_success(
+        dict(healthy, cache={"hit_ratio": 0.0,
+                             "sketch": _poisoned_sketch(epoch_hex)}),
+        now)
+    before = _counter("route_sketch_affinity")
+    ranked = r._rank(spec)
+    # equal load would rank a.sock first (CLI list order); the
+    # poisoned sketch prices b.sock as fully warm, so it wins — the
+    # mis-route false positives can cause, and the worst they can do
+    assert [b.target for b, _ in ranked] == ["b.sock", "a.sock"]
+    assert ranked[0][1]["affinity_hit_fraction"] == 1.0
+    assert _counter("route_sketch_affinity") == before + 1
+
+    # ... but the sketch only ever priced placement: the actual cache
+    # verifies every lookup by full 32-byte key, so a digest the
+    # poisoned sketch "contains" is still a MISS — wrong bytes cannot
+    # come out of a wrong sketch
+    cache = ResultCache(1 << 20)
+    claimed = affinity.job_digest_sample(spec)
+    bits = sketch.decode_bits(_poisoned_sketch(epoch_hex))
+    assert all(sketch.bits_contain(bits, d) for d in claimed)
+    assert all(cache.get(d) is MISS for d in claimed)
+    cache.close()
+
+    # a foreign-epoch poisoned sketch scores cold: no mis-route
+    r.backends[1].note_success(
+        dict(healthy, cache={"sketch": _poisoned_sketch("00" * 16)}),
+        obs_trace.now())
+    ranked = r._rank(spec)
+    assert [b.target for b, _ in ranked] == ["a.sock", "b.sock"]
+    assert "affinity_hit_fraction" not in (ranked[0][1] or {})
+
+
+def test_stale_health_doc_ages_out_of_cache_pricing(
+        tmp_path, monkeypatch):
+    """The r22 small fix: a dead backend's last-known hot cache block
+    (scalar hit ratio AND sketch) stops attracting placements once
+    the doc is older than the probe staleness window."""
+    monkeypatch.setenv("RACON_TPU_ROUTE_AFFINITY", "1")
+    spec = _priced_spec(tmp_path)
+    r = router.FleetRouter(str(tmp_path / "r.sock"), ["a", "b"])
+    epoch_hex = keying.engine_epoch().hex()
+    hot = {"ok": True, "status": "ok", "accepting": True,
+           "queue_depth": 0, "running": 0,
+           "cache": {"hit_ratio": 0.95,
+                     "sketch": _poisoned_sketch(epoch_hex)}}
+    stale = obs_trace.now() - (3 * r.probe_interval
+                               + r.probe_timeout + 1.0)
+    r.backends[1].note_success(dict(hot), stale)
+    assert r._cache_block(r.backends[1], obs_trace.now()) == {}
+    assert r._hit_ratio(r.backends[1], obs_trace.now()) == 0.0
+    r.backends[0].note_success({"ok": True, "status": "ok",
+                                "accepting": True, "queue_depth": 0,
+                                "running": 0}, obs_trace.now())
+    ranked = r._rank(spec)
+    assert "affinity_hit_fraction" not in (
+        dict(ranked)[r.backends[1]] or {})
+    # refreshed doc prices again
+    r.backends[1].note_success(dict(hot), obs_trace.now())
+    assert r._hit_ratio(r.backends[1], obs_trace.now()) == 0.95
+
+
+# ---------------------------------------------------------------------------
+# layer 2: adaptive fusion window
+# ---------------------------------------------------------------------------
+
+def test_adaptive_window_bounds_and_hysteresis(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_FUSE_WAIT_MS", "100")
+    monkeypatch.setenv("RACON_TPU_FUSE_ADAPT", "0")
+    ex = ex_mod.DeviceExecutor()
+    ceil = 0.1
+    # adapt off: the static env window, exactly
+    assert ex._current_fuse_wait_s() == pytest.approx(ceil)
+    ex._adapt_tick(0.0)
+    assert ex._current_fuse_wait_s() == pytest.approx(ceil)
+    ex.close()
+
+    monkeypatch.setenv("RACON_TPU_FUSE_ADAPT", "1")
+    ex = ex_mod.DeviceExecutor()
+    # seeds at the ceiling, then saturated occupancy shrinks the wait
+    assert ex._current_fuse_wait_s() == pytest.approx(ceil)
+    for _ in range(ex_mod._ADAPT_EVERY):
+        ex._adapt_tick(1.0)
+    w1 = ex._current_fuse_wait_s()
+    assert 0.0 < w1 < ceil
+    gauges = REGISTRY.snapshot().get("gauges", {})
+    assert gauges.get("fusion_wait_ms") == pytest.approx(w1 * 1e3)
+    # keeps shrinking under sustained saturation, never below zero
+    for _ in range(20 * ex_mod._ADAPT_EVERY):
+        ex._adapt_tick(1.0)
+    assert 0.0 <= ex._current_fuse_wait_s() < w1
+
+    # starved occupancy grows the wait back, clamped at the ceiling
+    for _ in range(40 * ex_mod._ADAPT_EVERY):
+        ex._adapt_tick(0.0)
+    assert ex._current_fuse_wait_s() == pytest.approx(ceil)
+
+    # dead-band hysteresis: in-band occupancy adjusts nothing
+    ex._adapt_occ = 0.7
+    ex._adapt_wait_s = 0.05
+    ex._adapt_since = ex_mod._ADAPT_EVERY - 1
+    ex._adapt_tick(0.7)
+    assert ex._adapt_wait_s == pytest.approx(0.05)
+    assert ex._adapt_since == 0          # the window still consumed
+    ex.close()
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    from racon_tpu.tools import simulate
+
+    tmp = str(tmp_path_factory.mktemp("ctrl_data"))
+    return simulate.simulate(tmp, genome_len=6_000, coverage=4,
+                             read_len=700, seed=33, ont=True)
+
+
+def _concurrent_fastas(dataset, adapt, wait_ms, monkeypatch):
+    from racon_tpu.serve.session import run_job
+
+    reads, paf, draft = dataset
+    monkeypatch.setenv("RACON_TPU_FUSE", "1")
+    monkeypatch.setenv("RACON_TPU_FUSE_WAIT_MS", str(wait_ms))
+    monkeypatch.setenv("RACON_TPU_FUSE_ADAPT",
+                       "1" if adapt else "0")
+    ex_mod._reset_for_tests()
+    sched = JobScheduler(run_job, max_queue=2, max_jobs=2)
+    try:
+        jobs = [sched.submit({
+            "sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 2, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1, "tenant": f"t{i}"})
+            for i in range(2)]
+        for j in jobs:
+            assert j.done.wait(300)
+    finally:
+        sched.drain(timeout=60)
+        ex_mod._reset_for_tests()
+    for j in jobs:
+        assert j.result.get("ok"), j.result
+    return [j.result["fasta_b64"] for j in jobs]
+
+
+def test_adaptive_fusion_on_off_byte_identity(dataset, monkeypatch):
+    # the controller only moves WHEN batches dispatch, never what is
+    # in them: adaptive runs under two different ceilings (different
+    # timing jitter) and the static run all produce identical bytes
+    on_fast = _concurrent_fastas(dataset, True, 30, monkeypatch)
+    on_slow = _concurrent_fastas(dataset, True, 5, monkeypatch)
+    off = _concurrent_fastas(dataset, False, 30, monkeypatch)
+    assert on_fast == on_slow == off
+    assert len(set(off)) == 1
+
+
+# ---------------------------------------------------------------------------
+# layer 3: deadline classes
+# ---------------------------------------------------------------------------
+
+def _stub_scheduler(max_queue=8, max_jobs=1):
+    return JobScheduler(lambda job: {"ok": True, "fasta_b64": ""},
+                        max_queue=max_queue, max_jobs=max_jobs)
+
+
+def test_class_validated_and_ordered(tmp_path, monkeypatch):
+    spec = _tiny_spec(tmp_path)
+    sched = _stub_scheduler()
+    sched.pause()
+    try:
+        with pytest.raises(RejectError) as exc:
+            sched.submit(dict(spec, **{"class": "bulk"}))
+        assert exc.value.error["code"] == "bad_request"
+        # same priority: interactive pops before earlier-queued batch
+        sched.submit(dict(spec, **{"class": "batch"}))
+        sched.submit(dict(spec, **{"class": "interactive"}))
+        with sched._cond:
+            first = sched._pop_next_job()
+            second = sched._pop_next_job()
+        assert first.job_class == "interactive"
+        assert second.job_class == "batch"
+        # explicit priority still beats class rank
+        sched.submit(dict(spec, **{"class": "batch"}), priority=5)
+        sched.submit(dict(spec, **{"class": "interactive"}))
+        with sched._cond:
+            assert sched._pop_next_job().job_class == "batch"
+        snap = sched.snapshot()
+        assert snap["classes"]["interactive"]["queued"] == 1
+    finally:
+        sched.drain(timeout=30)
+
+
+def test_batch_starvation_bound(tmp_path, monkeypatch):
+    # bound = CLASS_STARVATION_FACTOR x target p99 = 4 x 0.05 = 0.2 s
+    monkeypatch.setenv("RACON_TPU_CLASS_TARGET_P99_S", "0.05")
+    spec = _tiny_spec(tmp_path)
+    sched = _stub_scheduler()
+    sched.pause()
+    try:
+        sched.submit(dict(spec, **{"class": "batch"}))
+        time.sleep(0.3)              # age it past the bound
+        sched.submit(dict(spec, **{"class": "interactive"}))
+        before = _counter("serve_class_aged_pops")
+        with sched._cond:
+            job = sched._pop_next_job()
+        # the aged batch job jumps the interactive head: a steady
+        # interactive stream delays batch work only boundedly
+        assert job.job_class == "batch"
+        assert _counter("serve_class_aged_pops") == before + 1
+        with sched._cond:
+            assert sched._pop_next_job().job_class == "interactive"
+    finally:
+        sched.drain(timeout=30)
+
+
+def test_batch_admission_headroom_scales_with_slo(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("RACON_TPU_CLASS_HEADROOM", "0.25")
+    monkeypatch.setenv("RACON_TPU_CLASS_TARGET_P99_S", "2.0")
+    spec = _tiny_spec(tmp_path)
+    # pin the observed p99 (the real histogram accumulates across the
+    # whole suite run): first no data, then a 4x SLO miss
+    monkeypatch.setattr(sched_mod, "_class_wait_p99", lambda c: None)
+    sched = _stub_scheduler(max_queue=4)
+    sched.pause()
+    try:
+        assert sched._batch_reserved_slots() == 1
+        for _ in range(3):
+            sched.submit(dict(spec, **{"class": "batch"}))
+        # queue 3/4: the last slot is reserved for interactive work
+        with pytest.raises(RejectError) as exc:
+            sched.submit(dict(spec, **{"class": "batch"}))
+        assert exc.value.error["code"] == "queue_full"
+        assert exc.value.error["reserved_slots"] == 1
+        assert exc.value.error["retry_after_s"] > 0
+        sched.submit(dict(spec, **{"class": "interactive"}))
+        # a missed interactive SLO grows the reservation (capped at
+        # half the queue): observed attainment drives admission
+        monkeypatch.setattr(sched_mod, "_class_wait_p99",
+                            lambda c: 8.0)
+        assert sched._batch_reserved_slots() == 2
+        # interactive weight scales with the same miss ratio (8x cap)
+        job = sched_mod.Job(1, spec, 0, None,
+                            job_class="interactive")
+        assert sched._class_weight(job) == 8.0
+        batch = sched_mod.Job(2, spec, 0, None, job_class="batch")
+        assert sched._class_weight(batch) == 1.0
+    finally:
+        sched.drain(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# layer 4: drift-triggered recalibration epochs
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def calib_sandbox(tmp_path, monkeypatch):
+    monkeypatch.setenv("RACON_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("RACON_TPU_RECALIBRATE", raising=False)
+    monkeypatch.delenv("RACON_TPU_RATE_POA_DEV", raising=False)
+    monkeypatch.delenv("RACON_TPU_RATE_POA_CPU", raising=False)
+    calibrate._reset_drift_for_tests()
+    calhealth._reset_for_tests()
+    yield tmp_path
+    calibrate._reset_drift_for_tests()
+    calhealth._reset_for_tests()
+
+
+def _poa_rates():
+    return calibrate.get_rates("poa", 1, 0.30, 2.0)
+
+
+def test_drift_epoch_lifts_freeze_for_one_two_pass(
+        calib_sandbox, monkeypatch):
+    # seed a converged (gen 2 = frozen) calibration, then freeze
+    monkeypatch.delenv("RACON_TPU_CALIB_FREEZE", raising=False)
+    calibrate.store_rates("poa", 1, 111.0, 5.0)
+    calibrate.store_rates("poa", 1, 222.0, 5.0)
+    assert _poa_rates() == (222.0, 5.0, "calibrated")
+    monkeypatch.setenv("RACON_TPU_CALIB_FREEZE", "1")
+    pin = calibrate.epoch_snapshot()     # an in-flight job's r17 pin
+    calibrate.store_rates("poa", 1, 999.0, 9.0)   # frozen: no-op
+    assert _poa_rates()[0] == 222.0
+
+    assert calibrate.open_drift_epoch() is True
+    assert calibrate.open_drift_epoch() is False     # idempotent
+    # first store per stage restarts the two-pass sequence at gen 1
+    calibrate.store_rates("poa", 1, 333.0, 6.0)
+    assert _poa_rates() == (333.0, 6.0, "calibrated")
+    # second pass converges it; the gen>=2 freeze re-arms
+    calibrate.store_rates("poa", 1, 444.0, 6.0)
+    assert _poa_rates()[0] == 444.0
+    calibrate.store_rates("poa", 1, 555.0, 6.0)
+    assert _poa_rates()[0] == 444.0      # frozen again, epoch open
+
+    assert calibrate.note_drift_job() is False
+    assert calibrate.note_drift_job() is True        # closed at 2
+    assert calibrate.drift_epoch_state() == {"open": False, "jobs": 2}
+    calibrate.store_rates("poa", 1, 666.0, 6.0)      # serve freeze
+    assert _poa_rates()[0] == 444.0                  # holds again
+
+    # the in-flight job admitted before the epoch still prices under
+    # its pinned snapshot: rates never change under a running job
+    assert calibrate.get_rates("poa", 1, 0.30, 2.0,
+                               pin=pin["data"]) == \
+        (222.0, 5.0, "pinned")
+
+
+def test_scheduler_opens_epoch_on_drift_with_cooldown(
+        calib_sandbox, monkeypatch):
+    monkeypatch.setenv("RACON_TPU_CALIB_DRIFT_EPOCH", "1")
+    # EWMA ratio 10x: well outside the advisory band
+    calhealth.observe("poa", 1.0, 10.0)
+    assert calhealth.summary()["stages"]["poa"]["drift"] is True
+    sched = _stub_scheduler()
+    try:
+        before = _counter("calib_drift_epochs")
+        sched._drift_epoch_tick()
+        assert calibrate.drift_epoch_state()["open"] is True
+        assert _counter("calib_drift_epochs") == before + 1
+        # reset_stage cleared the module EWMA: the next observation
+        # re-seeds instead of averaging across the epoch boundary
+        assert "poa" not in calhealth._ewma
+        # two job boundaries close it
+        sched._drift_epoch_tick()
+        sched._drift_epoch_tick()
+        assert calibrate.drift_epoch_state()["open"] is False
+        # the registry gauge still shows the PRE-epoch drift (stale
+        # until the next observation) — the reopen cooldown is what
+        # keeps that stale value from immediately re-triggering
+        assert calhealth.summary()["stages"]["poa"]["drift"] is True
+        for _ in range(sched.DRIFT_REOPEN_COOLDOWN):
+            sched._drift_epoch_tick()
+            assert calibrate.drift_epoch_state()["open"] is False
+        sched._drift_epoch_tick()
+        assert calibrate.drift_epoch_state()["open"] is True
+    finally:
+        sched.drain(timeout=30)
+
+
+def test_drift_epoch_disabled_by_default(calib_sandbox, monkeypatch):
+    monkeypatch.delenv("RACON_TPU_CALIB_DRIFT_EPOCH", raising=False)
+    calhealth.observe("poa", 1.0, 10.0)
+    sched = _stub_scheduler()
+    try:
+        sched._drift_epoch_tick()
+        assert calibrate.drift_epoch_state()["open"] is False
+    finally:
+        sched.drain(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# satellites: knob provenance + fleet discovery
+# ---------------------------------------------------------------------------
+
+def test_r22_knobs_registered_and_epoch_excluded():
+    from racon_tpu.obs.provenance import KNOWN_KNOBS
+
+    for knob in ("RACON_TPU_ROUTE_AFFINITY", "RACON_TPU_FUSE_ADAPT",
+                 "RACON_TPU_CALIB_DRIFT_EPOCH",
+                 "RACON_TPU_CLASS_TARGET_P99_S",
+                 "RACON_TPU_CLASS_HEADROOM"):
+        # every r22 control knob is provenance-tracked AND excluded
+        # from cache keying: flipping a controller must not orphan
+        # every cached unit (the controllers cannot change bytes)
+        assert knob in KNOWN_KNOBS, knob
+        assert knob in keying.EPOCH_EXCLUDE, knob
+
+
+def test_resolve_fleet_targets(tmp_path):
+    # a comma list is the explicit fleet, passed through untouched
+    assert fleet.resolve_fleet_targets("a.sock,b.sock") == \
+        ["a.sock", "b.sock"]
+    assert fleet.resolve_fleet_targets("") == []
+    # a single unreachable target degrades to a one-element fleet
+    # (a DOWN router behaves like a DOWN daemon row)
+    dead = str(tmp_path / "nope.sock")
+    assert fleet.resolve_fleet_targets(dead, timeout=0.2) == [dead]

@@ -649,12 +649,18 @@ def _statable_spec(tmp_path):
             "targets": str(draft)}
 
 
-def test_rank_cache_affinity_tiebreak(tmp_path):
+def test_rank_cache_affinity_tiebreak(tmp_path, monkeypatch):
     from racon_tpu.obs import REGISTRY
     from racon_tpu.obs import flight as obs_flight
+    from racon_tpu.obs import trace as obs_trace
 
+    # pin the pre-r22 SCALAR tiebreak path: with content-digest
+    # affinity on, a statable spec takes the sketch-pricing path in
+    # _rank instead (tests/test_control.py covers that), and the r22
+    # age guard drops health docs not stamped with the real clock
+    monkeypatch.setenv("RACON_TPU_ROUTE_AFFINITY", "0")
     r = router.FleetRouter(str(tmp_path / "r.sock"), ["a", "b"])
-    now = 1.0
+    now = obs_trace.now()
     healthy = {"ok": True, "status": "ok", "accepting": True,
                "queue_depth": 0, "running": 0}
     r.backends[0].note_success(
