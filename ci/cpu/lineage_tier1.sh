@@ -1,0 +1,154 @@
+#!/usr/bin/env bash
+# Fleet-forensics tier-1 (ISSUE 19 / r23 CI satellite): lineage
+# assembly is READ-ONLY by construction — collecting flight events,
+# journal slices, trace slices and clock anchors from a live fleet
+# must never move a byte of polished output.
+#
+#   1. the FULL tier-1 suite with the forensic surfaces pinned on —
+#      flight ring, journal, per-job trace capture are all defaults,
+#      pinned here so the lane stays meaningful if a default ever
+#      flips — under PYTHONDEVMODE=1 (leaked sockets / unclosed
+#      journal fds from the new query ops fail the suite) with the
+#      faulthandler timeout dumping all stacks if a bounded query
+#      or the concurrent collector ever deadlocks.
+#   2. a 2-backend router smoke: one scattered keyed submit through
+#      a real router over two subprocess daemons, then
+#      `assemble()` against the live fleet — the lineage must be
+#      COMPLETE (every derived shard key accounted, exactly one
+#      winner per shard), `racon-tpu inspect --fleet` must exit 0
+#      and write a loadable merged Perfetto doc, and the routed
+#      FASTA must be byte-identical to the one-shot CLI run of the
+#      same inputs — forensics on, bytes unmoved.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export PYTHONDEVMODE=1
+export RACON_TPU_FLIGHT=1
+export RACON_TPU_JOURNAL=1
+unset RACON_TPU_FAULT || true
+python -m pytest tests/ -q -m "not slow" \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"
+
+echo "[lineage_tier1] 2-backend lineage assembly vs one-shot CLI"
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+JAX_PLATFORMS=cpu python - "$work" <<'EOF'
+import base64
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+from racon_tpu.tools import simulate
+
+work = sys.argv[1]
+reads, paf, draft = simulate.simulate(work, genome_len=12_000,
+                                      coverage=5, read_len=900,
+                                      seed=7, ont=True)
+env = dict(os.environ)
+env.update({"JAX_PLATFORMS": "cpu", "RACON_TPU_CLI_PREWARM": "0",
+            "RACON_TPU_FLIGHT": "1", "RACON_TPU_JOURNAL": "1",
+            "RACON_TPU_ROUTE_PROBE_S": "0.4"})
+
+ref = subprocess.run(
+    [sys.executable, "-m", "racon_tpu.cli", "-t", "4", "-c", "1",
+     "--tpualigner-batches", "1", reads, paf, draft],
+    capture_output=True, env=env, timeout=600)
+assert ref.returncode == 0, ref.stderr.decode()
+assert ref.stdout.startswith(b">")
+
+
+def start(name, args):
+    sock = os.path.join(work, name + ".sock")
+    log_path = os.path.join(work, name + ".log")
+    with open(log_path, "ab") as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "racon_tpu.cli", *args,
+             "--socket", sock],
+            stdout=log, stderr=log, env=env)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                name + " died: " + open(log_path).read()[-2000:])
+        if os.path.exists(sock):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock)
+            except OSError:
+                pass
+            else:
+                break
+            finally:
+                probe.close()
+        time.sleep(0.2)
+    else:
+        proc.kill()
+        raise AssertionError(name + " socket never came up")
+    return proc, sock
+
+
+from racon_tpu.obs import assemble
+from racon_tpu.serve import client
+
+procs = []
+key = "lineage-smoke"
+try:
+    b0, s0 = start("b0", ("serve",))
+    b1, s1 = start("b1", ("serve",))
+    procs += [(b0, s0), (b1, s1)]
+    router, rsock = start("router",
+                          ("route", "--backends", s0 + "," + s1))
+    procs.append((router, rsock))
+    spec = {"sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 4, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1, "tenant": "linsmoke"}
+    resp = client.submit(rsock, spec, job_key=key, shards=2)
+    assert resp.get("ok"), resp.get("error")
+    assert base64.b64decode(resp["fasta_b64"]) == ref.stdout, (
+        "routed bytes != one-shot CLI bytes with forensics on")
+
+    collection, lineage = assemble.assemble(rsock, job_key=key)
+    assert lineage["schema"] == "racon-tpu-lineage-v1"
+    assert lineage["complete"], lineage["warnings"]
+    winners = [n for n in lineage["nodes"] if n["winner"]]
+    assert sorted(n["shard"] for n in winners) == [0, 1], winners
+    # both shard attempts carry the adopted fleet trace id and a
+    # backend journal done record surfaced through journal_query
+    assert all(p["trace_id"] == key
+               for p in resp["report"]["per_shard"])
+    journaled = [d for d in collection["daemons"]
+                 if (d.get("journal") or {}).get("records")]
+    assert journaled, "no backend journal records collected"
+
+    trace_path = os.path.join(work, "merged.json")
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "inspect",
+         "--fleet", rsock, "--job-key", key,
+         "--trace-out", trace_path],
+        capture_output=True, env=env, timeout=300)
+    assert run.returncode == 0, (run.stdout, run.stderr)
+    assert b"complete" in run.stdout
+    with open(trace_path) as fh:
+        doc = json.load(fh)
+    assert doc["traceEvents"], "merged trace doc is empty"
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert len(names) == 3, names    # router + 2 backends
+finally:
+    for proc, sock in procs:
+        if proc.poll() is None:
+            try:
+                client.admin(sock, "shutdown")
+            except Exception:
+                proc.terminate()
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+print("lineage complete, 2 winners, merged trace written; "
+      "forensics-on bytes == one-shot CLI bytes")
+EOF
+echo "LINEAGE CI PASS"

@@ -32,7 +32,7 @@ USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequenc
        racon-tpu status --socket PATH [--json]
        racon-tpu top (--socket PATH | --fleet S1,S2,..) [--interval S] [--once] [--json]
        racon-tpu metrics (--socket PATH | --fleet S1,S2,..) [--json|--prometheus]
-       racon-tpu inspect (--socket PATH | --dump FILE) [--job N] [--json]
+       racon-tpu inspect (--socket PATH | --dump FILE | --fleet ADDR --job-key K) [--job N] [--trace-out FILE] [--json]
        racon-tpu explain (--socket PATH | --metrics-json FILE) [--job N] [--json]
 
     subcommands (racon_tpu/serve — persistent polishing service):
@@ -65,7 +65,11 @@ USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequenc
                  instance="<daemon_id>" labels)
         inspect  render a job's timeline (queue wait, exec, fused
                  dispatches with occupancy) from a live daemon's
-                 flight recorder or a post-mortem flight dump
+                 flight recorder or a post-mortem flight dump;
+                 --fleet --job-key K assembles one job's fleet-wide
+                 lineage (scatter/rebalance/failover/dedup/gather)
+                 with clock-aligned per-daemon lanes and an optional
+                 merged Perfetto trace (--trace-out)
         explain  render the decision plane: a job's cost waterfall
                  (stage walls, decision counts) and the per-stage
                  predicted-vs-actual calibration-health table, from

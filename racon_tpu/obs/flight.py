@@ -135,10 +135,15 @@ class FlightRecorder:
 
     # -- reading -------------------------------------------------------
 
-    def snapshot(self, job=None, last: int = 0) -> list:
+    def snapshot(self, job=None, last: int = 0, job_key: str = None,
+                 trace_id: str = None) -> list:
         """Copies of ring events, oldest first; ``job`` filters to
         events tagged with (or spanning, via a ``jobs`` list) that
-        job; ``last`` keeps only the newest N after filtering."""
+        job; ``job_key`` keeps events whose ``job_key``/``key`` field
+        equals it OR extends it with the r20 derived-key grammar
+        (``<key>-shard-...``), so one query sees a scattered job's
+        whole family; ``trace_id`` is an exact match; ``last`` keeps
+        only the newest N after filtering."""
         with self._lock:
             evs = [dict(ev) for ev in self._ring]
         if job is not None:
@@ -146,6 +151,21 @@ class FlightRecorder:
             evs = [ev for ev in evs
                    if ev.get("job") == job
                    or job in ev.get("jobs", ())]
+        if job_key is not None:
+
+            def _key_match(ev):
+                for f in ("job_key", "key", "winner_key"):
+                    k = ev.get(f)
+                    if isinstance(k, str) and (
+                            k == job_key
+                            or k.startswith(job_key + "-shard-")):
+                        return True
+                return False
+
+            evs = [ev for ev in evs if _key_match(ev)]
+        if trace_id is not None:
+            evs = [ev for ev in evs
+                   if ev.get("trace_id") == trace_id]
         if last and last > 0:
             evs = evs[-last:]
         return evs

@@ -66,6 +66,15 @@ def epoch_offset(t: float) -> float:
     return t - _EPOCH
 
 
+def epoch_wall() -> float:
+    """Wall-clock time of this process's trace epoch — the anchor a
+    fleet assembler (racon_tpu/obs/assemble.py) uses to lift this
+    process's monotonic epoch offsets onto the wall clock:
+    ``wall_t ≈ epoch_wall() + epoch_offset(t)``.  Forensics only;
+    never feeds control flow or bytes."""
+    return wall_now() - (now() - _EPOCH)
+
+
 class Tracer:
     # virtual lanes get tids above this floor so they sort after the
     # real threads in the Perfetto track list
@@ -87,6 +96,7 @@ class Tracer:
         self._lanes: dict = {}       # lane name -> virtual tid
         self._job_capture = False
         self._by_job: OrderedDict = OrderedDict()  # job -> deque(ev)
+        self._evicted = 0            # jobs dropped from the LRU
 
     # -- gating --------------------------------------------------------
 
@@ -170,6 +180,7 @@ class Tracer:
                             deque(maxlen=self._JOB_SPANS)
                         while len(self._by_job) > self._JOB_MAX:
                             self._by_job.popitem(last=False)
+                            self._evicted += 1
                     dq.append(ev)
 
     def add_span(self, name: str, t0: float, t1: float,
@@ -241,6 +252,17 @@ class Tracer:
         evs.sort(key=lambda ev: ev.get("ts", 0.0))
         return evs
 
+    def capture_stats(self) -> dict:
+        """Depth/rollover counters for the per-job capture index —
+        surfaced through ``health`` so a fleet assembler can warn
+        when a job's slice was evicted before collection."""
+        with self._lock:
+            return {"job_capture": self._job_capture,
+                    "jobs": len(self._by_job),
+                    "max_jobs": self._JOB_MAX,
+                    "spans_per_job": self._JOB_SPANS,
+                    "evicted": self._evicted}
+
     # -- output --------------------------------------------------------
 
     def write(self, path: str = None) -> str:
@@ -269,6 +291,7 @@ class Tracer:
             self._tids.clear()
             self._lanes.clear()
             self._by_job.clear()
+            self._evicted = 0
 
 
 TRACER = Tracer()

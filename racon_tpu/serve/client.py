@@ -229,16 +229,53 @@ def route_status(socket_path: str, timeout: float = 30.0) -> dict:
 
 
 def flight(socket_path: str, job=None, last: int = 0,
+           job_key: str = None, trace_id: str = None,
            timeout: float = 30.0) -> dict:
     """Live flight-recorder view: ring stats + events, optionally
-    filtered to one ``job`` (adds its trace slice as ``job_trace``)
-    or the newest ``last`` events."""
+    filtered to one ``job`` (adds its trace slice as ``job_trace``),
+    an idempotence-key family (``job_key`` — the key plus its r20/r21
+    derived shard/rebalance keys), an exact ``trace_id``, or the
+    newest ``last`` events."""
     frame = {"op": "flight"}
     if job is not None:
         frame["job"] = int(job)
     if last:
         frame["last"] = int(last)
+    if job_key is not None:
+        frame["job_key"] = job_key
+    if trace_id is not None:
+        frame["trace_id"] = trace_id
     return request(socket_path, frame, timeout=timeout)
+
+
+def journal_query(socket_path: str, job_key: str = None,
+                  job_key_prefix: str = None,
+                  max_records: int = 256, max_bytes: int = None,
+                  timeout: float = 30.0) -> dict:
+    """Bounded read-only slice of a daemon's write-ahead journal
+    (r23 ``journal_query``).  A key filter and ``max_records`` are
+    REQUIRED by the wire contract — the server answers
+    ``bad_request`` to unbounded asks; routers and journal-off
+    daemons answer ``{"ok": true, "enabled": false}``."""
+    frame = {"op": "journal_query", "max_records": int(max_records)}
+    if job_key is not None:
+        frame["job_key"] = job_key
+    if job_key_prefix is not None:
+        frame["job_key_prefix"] = job_key_prefix
+    if max_bytes is not None:
+        frame["max_bytes"] = int(max_bytes)
+    return request(socket_path, frame, timeout=timeout)
+
+
+def trace_query(socket_path: str, job, max_events: int = 2048,
+                timeout: float = 30.0) -> dict:
+    """Bounded per-job trace slice (r23 ``trace_query``): the events
+    ``submit --trace`` would have attached, readable after the fact.
+    ``max_events`` is required by the wire contract."""
+    return request(socket_path,
+                   {"op": "trace_query", "job": int(job),
+                    "max_events": int(max_events)},
+                   timeout=timeout)
 
 
 def explain(socket_path: str, job=None, last: int = 0,

@@ -78,6 +78,29 @@ def fleet_stale_s() -> float:
     return max(0.1, _env_float("RACON_TPU_FLEET_STALE_S", 10.0))
 
 
+def scrape_concurrently(targets, fn, timeout_s: float = None):
+    """Run ``fn(target) -> row`` once per target, one short-lived
+    thread each (the same shape :class:`FleetScraper` polls with),
+    and return the rows in ``targets`` order.  A worker that hangs
+    past the join budget leaves ``None`` in its slot; ``fn`` is
+    expected to catch its own errors and degrade to an error row —
+    this helper never raises on a worker's behalf.  Shared by the
+    r23 fleet forensics collector (racon_tpu/obs/assemble.py)."""
+    timeout_s = fleet_timeout_s() if timeout_s is None else timeout_s
+    rows = [None] * len(targets)
+
+    def run(idx, target):
+        rows[idx] = fn(target)
+
+    threads = [threading.Thread(target=run, args=(i, t), daemon=True)
+               for i, t in enumerate(targets)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout_s + 5.0)
+    return rows
+
+
 class FleetScraper:
     """Concurrent multi-target ``metrics`` scraper with per-target
     staleness.  ``targets`` is a list of unix-socket paths.  Use

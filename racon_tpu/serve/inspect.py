@@ -24,6 +24,14 @@ Sources:
   with ``--job``, the bounded per-job trace slice rides along).
 * ``--dump FILE`` — reads a flight dump written on drain/idle/crash
   (racon_tpu/obs/flight.py) or by ``RACON_TPU_FLIGHT_DUMP``.
+* ``--fleet ADDR --job-key K`` (r23) — fleet forensics: collects
+  flight events, journal records and trace slices from the router
+  and every backend it discloses, reconstructs the lineage DAG
+  (racon_tpu/obs/assemble.py: scatter shards, rebalance attempts,
+  failovers, dedup joins, gather winners) and renders a
+  clock-offset-corrected cross-daemon timeline.  ``--trace-out``
+  additionally writes the merged Perfetto-loadable trace doc;
+  ``--json`` prints the ``racon-tpu-lineage-v1`` document.
 
 Read-only: no op used here touches queue or job state.
 """
@@ -206,25 +214,80 @@ def build_arg_parser() -> argparse.ArgumentParser:
     src.add_argument("--dump",
                      help="flight dump JSON written on "
                      "drain/idle/crash")
+    src.add_argument("--fleet", metavar="ADDR",
+                     help="router (or daemon) address for fleet "
+                     "forensics: lineage DAG + clock-aligned "
+                     "cross-daemon timeline (needs --job-key or "
+                     "--trace-id)")
     p.add_argument("--job", type=int, default=None,
                    help="job id to render (omit for a per-job "
                    "summary of the whole source)")
+    p.add_argument("--job-key", default=None,
+                   help="with --fleet: the job's idempotence key "
+                   "(lineage covers its derived shard/rebalance "
+                   "keys)")
+    p.add_argument("--trace-id", default=None,
+                   help="with --fleet: wire trace id to assemble "
+                   "instead of a job key")
+    p.add_argument("--trace-out", metavar="FILE", default=None,
+                   help="with --fleet: also write the merged "
+                   "Perfetto-loadable trace document here")
     p.add_argument("--last", type=int, default=0,
                    help="with --socket and no --job: only the newest "
                    "N events")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="with --fleet: per-target timeout in seconds "
+                   "(default RACON_TPU_FLEET_TIMEOUT_S)")
     p.add_argument("--json", action="store_true",
                    help="print the raw event document instead of the "
-                   "rendered timeline")
+                   "rendered timeline (with --fleet: the "
+                   "racon-tpu-lineage-v1 document)")
     return p
+
+
+def main_fleet(args) -> int:
+    """The ``--fleet`` path: collect, build the lineage DAG, render.
+    Exit status reflects lineage completeness (0 complete, 1 not) so
+    scripts can gate on it."""
+    from racon_tpu.obs import assemble
+    if not args.job_key and not args.trace_id:
+        print("[racon_tpu::inspect] --fleet needs --job-key or "
+              "--trace-id", file=sys.stderr)
+        return 2
+    try:
+        collection, lineage = assemble.assemble(
+            args.fleet, job_key=args.job_key,
+            trace_id=args.trace_id, timeout=args.timeout)
+    except Exception as exc:
+        print(f"[racon_tpu::inspect] error: {exc}", file=sys.stderr)
+        return 1
+    if args.trace_out:
+        doc = assemble.merged_trace_doc(lineage, collection)
+        with open(args.trace_out, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        print(f"[racon_tpu::inspect] merged trace -> "
+              f"{args.trace_out} ({len(doc['traceEvents'])} "
+              f"event(s))", file=sys.stderr)
+    if args.json:
+        json.dump(lineage, sys.stdout, indent=1)
+        print()
+    else:
+        sys.stdout.write(
+            assemble.render_fleet_timeline(lineage, collection))
+    return 0 if lineage.get("complete") else 1
 
 
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
+    if args.fleet:
+        return main_fleet(args)
     if args.socket:
         from racon_tpu.serve import client
         try:
             doc = client.flight(args.socket, job=args.job,
-                                last=args.last)
+                                last=args.last,
+                                job_key=args.job_key,
+                                trace_id=args.trace_id)
         except client.ServeError as exc:
             print(f"[racon_tpu::inspect] error: {exc}",
                   file=sys.stderr)

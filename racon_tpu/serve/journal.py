@@ -52,6 +52,14 @@ are wall-clock (``obs.trace.wall_now``): journal records are cross-process
 identifiers read by a LATER process, so the per-process trace epoch
 the flight ring uses would not correlate.
 
+Read surfaces: recovery replay (racon_tpu/serve/recover.py) and,
+since r23, the bounded ``journal_query`` wire op — a key-filtered,
+record/byte-capped slice served off :func:`scan` against the file
+path (never the live append handle), with ``done`` result bodies
+slimmed to sizes.  The fleet forensics assembler
+(racon_tpu/obs/assemble.py) aligns the wall-clock ``t`` of these
+records onto a collector timeline via per-daemon offset estimates.
+
 Knobs (provenance.KNOWN_KNOBS): ``RACON_TPU_JOURNAL`` ("0"
 disables — the daemon then behaves exactly as before r17),
 ``RACON_TPU_JOURNAL_DIR`` (default: the socket's directory),

@@ -250,6 +250,59 @@ racon_tpu/serve/affinity.py + scheduler deadline classes):
   false positives only mis-price placement — the content-addressed
   unit keys still decide every actual cache hit, so bytes never
   depend on the sketch.
+
+Fleet forensics (r23, racon_tpu/obs/assemble.py +
+``racon-tpu inspect --fleet``):
+
+* ``journal_query`` op: ``{"op": "journal_query", "job_key": K |
+  "job_key_prefix": P, "max_records": N [, "max_bytes": B]}`` — a
+  bounded, READ-ONLY slice of the daemon's write-ahead journal.  A
+  key filter (exact key matches its whole r20/r21 derived family:
+  ``K``, ``K-shard-<i>of<k>``, ``...-r<n>``) AND a positive
+  ``max_records`` are required; an unbounded ask is ``bad_request``.
+  Caps: 1024 records / 8 MiB per response (asks above are clamped).
+  Records are returned oldest-first (the newest ``max_records`` of
+  the match); ``done`` records have their result frames slimmed —
+  ``fasta_b64`` is replaced by a ``fasta_bytes`` length so a
+  forensic read never hauls result payloads.  ``complete: false``
+  flags a clipped response, ``scan_truncated`` a torn journal tail.
+  Journal-off daemons and the router answer
+  ``{"ok": true, "enabled": false, "records": []}``.
+* ``trace_query`` op: ``{"op": "trace_query", "job": N,
+  "max_events": M}`` — the bounded per-job captured trace slice
+  (what ``submit --trace`` would have attached), readable after the
+  fact.  ``max_events`` required (cap 4096); ``complete: false``
+  when clipped.  The router answers from its own capture (r23
+  router forensic parity: ``route.submit`` / ``route.attempt``
+  spans).
+* Clock anchors: ``health``, ``flight``, ``journal_query`` and
+  ``trace_query`` responses carry ``wall_t`` (the daemon's wall
+  clock at reply build) and ``trace_epoch_wall`` (the wall time of
+  its monotonic trace epoch).  A collector estimates per-daemon
+  clock offsets from health-probe send/recv pairs (midpoint
+  estimator, min-RTT probe of three; confidence = half the round
+  trip) and uses them to align flight/trace/journal timestamps onto
+  one timeline.  RENDERING ONLY: offsets never steer control flow
+  and never touch job bytes.
+* ``health`` additionally reports ``capture`` — per-surface depth
+  (``flight`` ring size/capacity/dropped, ``trace`` per-job index
+  jobs/max_jobs/spans_per_job/evicted, ``journal`` enabled/path) —
+  so a fleet assembler can warn when a ring rolled over mid-job
+  instead of presenting a silently partial lineage.
+* ``flight`` accepts ``job_key`` (matches the key's derived family)
+  and ``trace_id`` (exact) filters alongside the existing ``job`` /
+  ``last``.
+* Trace-context adoption: a routed submit with no client
+  ``trace_context`` now adopts its idempotence key as the wire
+  trace id, and the router propagates it through every scatter /
+  rebalance / failover sub-submit, so all fragments of one
+  distributed job share one trace id.  Backend ``ok`` results carry
+  ``trace_id`` (journaled, so dedup replays keep the ORIGINAL id);
+  scatter reports carry per-shard ``trace_id``.  With ``trace``
+  set, a router's submit response adds ``router_pid`` /
+  ``router_flight_events`` / ``router_trace_events`` beside the
+  winning backend's — forensic parity between the two halves of a
+  routed job.
 """
 
 from __future__ import annotations

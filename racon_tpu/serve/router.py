@@ -214,6 +214,11 @@ _MAX_ROUND_WAIT_S = 10.0
 #: placement, and further copies only burn fleet capacity
 _REBALANCE_MAX_ATTEMPTS = 2
 
+#: r23 bounded forensic reads — mirrors the daemon's
+#: server.TRACE_QUERY_MAX_EVENTS (kept local so the router never
+#: imports the daemon's heavy module graph)
+_TRACE_QUERY_MAX_EVENTS = 4096
+
 
 class Backend:
     """One fronted daemon: last-known health + its circuit breaker.
@@ -374,6 +379,11 @@ class FleetRouter:
         self._scatter_live: dict = {}  # job_key -> progress doc
         self._tenant_recent: dict = {}  # tenant -> deque of targets
         self._keyseq = itertools.count(1)
+        # r23 router forensic parity: the router keeps its own
+        # bounded per-job trace capture (like the daemons since r14),
+        # keyed by a router-local routing id minted per owned submit
+        self._jobseq = itertools.count(1)
+        obs_trace.TRACER.enable_job_capture()
         self._t_start = obs_trace.now()
         self._drain_logged = False
         obs_flight.FLIGHT.install_dump_on_crash()
@@ -650,6 +660,13 @@ class FleetRouter:
                 "bad_request",
                 "job_key must be 1..128 chars of "
                 "[A-Za-z0-9._:-] starting alphanumeric")
+        trace_context = req.get("trace_context")
+        if trace_context is not None and \
+                not obs_context.valid_trace_id(trace_context):
+            return protocol.error_frame(
+                "bad_request",
+                "trace_context must be 1..128 chars of "
+                "[A-Za-z0-9._:-] starting alphanumeric")
         try:
             requested_shards = scatter.parse_requested(
                 req.get("shards"))
@@ -666,6 +683,18 @@ class FleetRouter:
             # the surviving backend could re-run work the dead one's
             # journal already recorded
             job_key = f"route-{os.getpid()}-{next(self._keyseq)}"
+        if trace_context is None:
+            # r23 bugfix: a context-less submit used to reach every
+            # backend with NO trace_context, so each scheduler minted
+            # its own unlinked <pid>-<job> id and sibling shards /
+            # failover retries could not be correlated.  The router
+            # adopts the job_key (client-supplied or just minted —
+            # both pass the same charset contract) as the fleet-wide
+            # trace context; every sub-submit below reads it from
+            # this shared req dict, so shards, rebalance attempts and
+            # failovers all land under one id
+            trace_context = job_key
+            req["trace_context"] = trace_context
         # in-router rendezvous: concurrent duplicates of one key join
         # the owner's routing (one placement, every caller gets the
         # same response) — the router-level twin of the scheduler's
@@ -679,18 +708,39 @@ class FleetRouter:
         if not owner:
             REGISTRY.add("route_dedup_joins")
             obs_flight.FLIGHT.record("route_dedup", job_key=job_key,
+                                     trace_id=trace_context,
                                      joined="live")
             live.done.wait()
             return live.response
+        # r23 router forensic parity: the owned submit gets a
+        # router-local routing id; every routing decision below is
+        # flight-tagged and span-captured under it, so the router's
+        # own per-job slice exists just like a backend's
+        rid = next(self._jobseq)
+        t_route0 = obs_trace.now()
         try:
             resp = self._submit_planned(spec, req, job_key,
-                                        requested_shards)
+                                        requested_shards, rid=rid)
         except Exception as exc:     # router bug: job fails, router
             obs_flight.FLIGHT.record_exception(   # survives
                 "route_error", exc)
             resp = protocol.error_frame(
                 "job_failed", f"router error: {exc}",
                 type=type(exc).__name__)
+        obs_trace.TRACER.add_span(
+            "route.submit", t_route0, obs_trace.now(), cat="route",
+            args={"job": rid, "job_key": job_key,
+                  "trace_id": trace_context,
+                  "ok": bool(resp.get("ok"))}, jobs=[rid])
+        if req.get("trace") and isinstance(resp, dict):
+            # router-side forensics ride the traced response so a
+            # routed `submit --trace` is not backend-only
+            resp = dict(resp)
+            resp["router_pid"] = os.getpid()
+            resp["router_flight_events"] = \
+                obs_flight.FLIGHT.snapshot(job=rid)
+            resp["router_trace_events"] = \
+                obs_trace.TRACER.job_slice(rid)
         with self._lock:
             self._live.pop(job_key, None)
             if resp.get("ok") and resp.get("routed_backend"):
@@ -704,12 +754,14 @@ class FleetRouter:
         return resp
 
     def _submit_planned(self, spec: dict, req: dict, job_key: str,
-                        requested) -> dict:
+                        requested, rid: int = None) -> dict:
         """Decide scatter vs unsharded for a submit this router owns,
         then run it.  Auto-scatter prices the whole job once at
         concurrency 1 (the single-backend wall the split is trying to
         beat) and only engages when RACON_TPU_SCATTER_MIN_WALL_S is
-        set; an explicit ``shards`` on the submit always wins."""
+        set; an explicit ``shards`` on the submit always wins.
+        ``rid`` is the router-local routing id the owned submit's
+        forensics are captured under (r23)."""
         n_eligible = sum(1 for b in self.backends if b.eligible())
         wall = None
         if requested is None and scatter.min_wall_s() is not None:
@@ -718,10 +770,11 @@ class FleetRouter:
                 wall = est.get("predicted_wall_s")
         k = scatter.plan_shards(requested, wall, n_eligible)
         if k <= 1:
-            return self._route_job(spec, req, job_key)
-        return self._scatter_job(spec, req, job_key, k)
+            return self._route_job(spec, req, job_key, rid=rid)
+        return self._scatter_job(spec, req, job_key, k, rid=rid)
 
-    def _plan_stage(self, spec: dict, k: int) -> dict:
+    def _plan_stage(self, spec: dict, k: int, rid: int = None,
+                    trace_id: str = None) -> dict:
         """r21 staged inputs: build the overlaps slice index ONCE at
         plan time (racon_tpu/io/staging.py) and derive each shard's
         ``stage`` hint from it, so the K daemons skip the (K-1)/K of
@@ -746,14 +799,14 @@ class FleetRouter:
             return {}
         REGISTRY.add("route_stage_plans")
         obs_flight.FLIGHT.record(
-            "route_stage_plan", shards=k,
+            "route_stage_plan", job=rid, trace_id=trace_id, shards=k,
             total_bytes=hints[0].get("total_bytes"),
             staged_bytes=[hints[i].get("staged_bytes")
                           for i in range(k)])
         return hints
 
     def _scatter_job(self, spec: dict, req: dict, job_key: str,
-                     k: int) -> dict:
+                     k: int, rid: int = None) -> dict:
         """Fan a mega-job out as K target-sharded sub-jobs and gather
         the merged reply.  Each shard is a full :meth:`_route_job` —
         independently priced, spilled over, failed over — under the
@@ -791,13 +844,15 @@ class FleetRouter:
         delivered them — and a superseded attempt's ``job_canceled``
         reply never fails the shard."""
         t0 = obs_trace.now()
+        trace_ctx = req.get("trace_context")
         REGISTRY.add("route_scatter_jobs")
         REGISTRY.add("route_scatter_shards", k)
         keys = [scatter.shard_key(job_key, i, k) for i in range(k)]
         eligible = [b.target for b in self.backends if b.eligible()]
         prefer = {i: eligible[i % len(eligible)]
                   for i in range(k)} if eligible else {}
-        stage_hints = self._plan_stage(spec, k)
+        stage_hints = self._plan_stage(spec, k, rid=rid,
+                                       trace_id=trace_ctx)
         # the plan's per-shard predicted walls: the p50 is the
         # straggler watchdog's yardstick for "this shard is late"
         predicted = []
@@ -827,6 +882,7 @@ class FleetRouter:
             })
         progress = {"job_key": job_key, "shards": k, "done": 0,
                     "backends": [None] * k, "p50_wall_s": p50,
+                    "rid": rid, "trace": trace_ctx,
                     "slots": slots}
 
         def settle(i: int, key: str, resp: dict) -> None:
@@ -857,8 +913,10 @@ class FleetRouter:
                     progress["done"] += 1
                     finished = True
             obs_flight.FLIGHT.record(
-                "route_scatter_shard", job_key=job_key, shard=i,
+                "route_scatter_shard", job=rid, job_key=job_key,
+                trace_id=trace_ctx, shard=i,
                 key=key, ok=bool(resp.get("ok")),
+                winner=(key == slot["winner_key"]),
                 backend=resp.get("routed_backend"),
                 wall_s=resp.get("wall_s"))
             if cancel_keys:
@@ -869,11 +927,12 @@ class FleetRouter:
                 slot["done"].set()
 
         def run_attempt(i: int, key: str, pref) -> None:
+            ta = obs_trace.now()
             try:
                 resp = self._route_job(
                     scatter.shard_spec(spec, i, k,
                                        stage=stage_hints.get(i)),
-                    req, key, prefer=pref)
+                    req, key, prefer=pref, rid=rid)
             except Exception as exc:  # router bug: the attempt fails,
                 # the gather must NOT hang on a slot that can never
                 # settle
@@ -882,6 +941,14 @@ class FleetRouter:
                         "error": {"code": "job_failed",
                                   "type": type(exc).__name__,
                                   "reason": str(exc)}}
+            if rid is not None:
+                obs_trace.TRACER.add_span(
+                    "route.attempt", ta, obs_trace.now(), cat="route",
+                    args={"job": rid, "job_key": job_key, "key": key,
+                          "shard": i, "trace_id": trace_ctx,
+                          "backend": resp.get("routed_backend"),
+                          "ok": bool(resp.get("ok"))},
+                    jobs=[rid])
             settle(i, key, resp)
 
         def launch(i: int, key: str, pref) -> None:
@@ -903,7 +970,8 @@ class FleetRouter:
         with self._lock:
             self._scatter_live[job_key] = progress
         obs_flight.FLIGHT.record(
-            "route_scatter", job_key=job_key, shards=k,
+            "route_scatter", job=rid, job_key=job_key,
+            trace_id=trace_ctx, shards=k, keys=keys,
             staged=bool(stage_hints), tenant=spec.get("tenant"))
         eprint(f"[racon_tpu::route] scatter: job {job_key} -> {k} "
                f"target shard(s)"
@@ -948,7 +1016,9 @@ class FleetRouter:
                 "staged_bytes": [s["staged_bytes"] for s in slots],
                 "rebalanced": [s["lineage"] for s in slots]}
             obs_flight.FLIGHT.record(
-                "route_gather", job_key=job_key, shards=k,
+                "route_gather", job=rid, job_key=job_key,
+                trace_id=trace_ctx, shards=k,
+                winner_keys=list(win_keys),
                 wall_s=round(wall, 6),
                 n_sequences=out.get("n_sequences"))
             return out
@@ -1050,8 +1120,10 @@ class FleetRouter:
                                         attempt)
             REGISTRY.add("route_rebalance")
             obs_flight.FLIGHT.record(
-                "route_rebalance", job_key=prog["job_key"],
-                shard=i, attempt=attempt, key=key, backend=target,
+                "route_rebalance", job=prog.get("rid"),
+                job_key=prog["job_key"], trace_id=prog.get("trace"),
+                shard=i, attempt=attempt, key=key,
+                superseded=superseded, backend=target,
                 elapsed_s=round(now - started, 3),
                 threshold_s=round(threshold, 3))
             eprint(f"[racon_tpu::route] rebalance: shard {i}of{k} "
@@ -1066,8 +1138,9 @@ class FleetRouter:
             self._broadcast_cancel(superseded)
 
     def _route_job(self, spec: dict, req: dict, job_key: str,
-                   prefer: str = None) -> dict:
+                   prefer: str = None, rid: int = None) -> dict:
         priority = int(req.get("priority", 0))
+        trace_ctx = req.get("trace_context")
         tenant = spec.get("tenant") if isinstance(spec, dict) else None
         dead = set()          # backends that transport-failed: never
         last_reject = None    # retried for THIS job this round-trip
@@ -1101,7 +1174,8 @@ class FleetRouter:
                     faultinject.hit("route-pre-forward")
                     REGISTRY.add("route_submit")
                     obs_flight.FLIGHT.record(
-                        "route", job_key=job_key,
+                        "route", job=rid, job_key=job_key,
+                        trace_id=trace_ctx,
                         backend=backend.target,
                         round=round_no, load=backend.load(),
                         predicted_wall_s=(round(est.get(
@@ -1125,7 +1199,8 @@ class FleetRouter:
                                                       str(exc))
                         REGISTRY.add("route_failover")
                         obs_flight.FLIGHT.record(
-                            "route_failover", job_key=job_key,
+                            "route_failover", job=rid,
+                            job_key=job_key, trace_id=trace_ctx,
                             backend=backend.target,
                             error=str(exc)[:200])
                         eprint(f"[racon_tpu::route] backend "
@@ -1146,7 +1221,8 @@ class FleetRouter:
                         backend.mark_draining()
                     REGISTRY.add("route_spillover")
                     obs_flight.FLIGHT.record(
-                        "route_spillover", job_key=job_key,
+                        "route_spillover", job=rid, job_key=job_key,
+                        trace_id=trace_ctx,
                         backend=backend.target, code=code)
                     try:
                         h = float(err["retry_after_s"])
@@ -1261,6 +1337,16 @@ class FleetRouter:
             "in_flight_jobs": in_flight,
             "queue_depth": 0,
             "running": in_flight,
+            # r23 fleet forensics: capture depths + clock anchors
+            # (same block shape as the daemon's — the router has no
+            # journal)
+            "capture": {
+                "flight": obs_flight.FLIGHT.stats(),
+                "trace": obs_trace.TRACER.capture_stats(),
+                "journal": {"enabled": False},
+            },
+            "wall_t": round(obs_trace.wall_now(), 6),
+            "trace_epoch_wall": round(obs_trace.epoch_wall(), 6),
         }
 
     def _metrics_doc(self) -> dict:
@@ -1289,17 +1375,68 @@ class FleetRouter:
         }
 
     def _flight_doc(self, req: dict) -> dict:
+        """Router flight view — r23 brings it to parity with the
+        daemon's: ``job`` (routing id), ``job_key`` (key + derived
+        family), ``trace_id`` and ``last`` filters, clock anchors,
+        and the per-job trace slice when a routing id is given."""
         try:
+            job = req.get("job")
+            job = int(job) if job is not None else None
             last = int(req.get("last", 0) or 0)
         except (TypeError, ValueError):
             return protocol.error_frame(
-                "bad_request", "flight: last must be an integer")
-        return {
+                "bad_request", "flight: job/last must be integers")
+        job_key = req.get("job_key")
+        trace_id = req.get("trace_id")
+        if (job_key is not None and not isinstance(job_key, str)) or \
+                (trace_id is not None
+                 and not isinstance(trace_id, str)):
+            return protocol.error_frame(
+                "bad_request",
+                "flight: job_key/trace_id must be strings")
+        doc = {
             "ok": True,
+            "router": True,
             "pid": os.getpid(),
             "identity": self._identity(),
             "ring": obs_flight.FLIGHT.stats(),
-            "events": obs_flight.FLIGHT.snapshot(last=last),
+            "events": obs_flight.FLIGHT.snapshot(
+                job=job, last=last, job_key=job_key,
+                trace_id=trace_id),
+            "wall_t": round(obs_trace.wall_now(), 6),
+            "trace_epoch_wall": round(obs_trace.epoch_wall(), 6),
+        }
+        if job is not None:
+            doc["job_trace"] = obs_trace.TRACER.job_slice(job)
+        return doc
+
+    def _trace_query_doc(self, req: dict) -> dict:
+        """Bounded per-routing-id trace slice (r23 ``trace_query``
+        parity with the daemon's op; same required bounds)."""
+        try:
+            job = int(req.get("job"))
+        except (TypeError, ValueError):
+            return protocol.error_frame(
+                "bad_request", "trace_query requires a job id")
+        try:
+            max_events = int(req.get("max_events"))
+        except (TypeError, ValueError):
+            max_events = 0
+        if max_events <= 0:
+            return protocol.error_frame(
+                "bad_request",
+                "trace_query requires max_events > 0 "
+                "(unbounded reads are refused)")
+        max_events = min(max_events, _TRACE_QUERY_MAX_EVENTS)
+        evs = obs_trace.TRACER.job_slice(job)
+        return {
+            "ok": True, "router": True, "pid": os.getpid(),
+            "identity": self._identity(), "job": job,
+            "complete": len(evs) <= max_events,
+            "events": evs[-max_events:],
+            "capture": obs_trace.TRACER.capture_stats(),
+            "wall_t": round(obs_trace.wall_now(), 6),
+            "trace_epoch_wall": round(obs_trace.epoch_wall(), 6),
         }
 
     # -- connection handling -------------------------------------------
@@ -1326,6 +1463,21 @@ class FleetRouter:
                 resp = self._metrics_doc()
             elif op == "flight":
                 resp = self._flight_doc(req)
+            elif op == "trace_query":
+                resp = self._trace_query_doc(req)
+            elif op == "journal_query":
+                # the router keeps no journal; answer the op (not
+                # bad_request) so a fleet-wide forensic sweep treats
+                # "no journal here" as data, not as an error row
+                resp = {
+                    "ok": True, "router": True, "enabled": False,
+                    "pid": os.getpid(),
+                    "identity": self._identity(),
+                    "records": [], "complete": True, "matched": 0,
+                    "wall_t": round(obs_trace.wall_now(), 6),
+                    "trace_epoch_wall":
+                        round(obs_trace.epoch_wall(), 6),
+                }
             elif op == "shutdown":
                 resp = {"ok": True, "draining": True}
                 self._stop.set()

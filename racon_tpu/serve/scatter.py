@@ -227,6 +227,7 @@ def merge_responses(responses, keys) -> dict:
             "job_key": keys[i],
             "backend": resp.get("routed_backend"),
             "job_id": resp.get("job_id"),
+            "trace_id": resp.get("trace_id"),
             "n_sequences": resp.get("n_sequences"),
             "wall_s": resp.get("wall_s"),
             "predicted_wall_s": est.get("predicted_wall_s"),

@@ -591,7 +591,7 @@ class JobScheduler:
                       "queue_depth": len(self._heap)})
             obs_flight.FLIGHT.record(
                 "admit", job=job.id, tenant=tenant,
-                trace_id=job.trace_id,
+                trace_id=job.trace_id, job_key=job.job_key,
                 priority=priority, job_class=job_class,
                 shard=(list(shard) if shard is not None else None),
                 predicted_wall_s=round(
@@ -706,7 +706,7 @@ class JobScheduler:
                     f"serve_class_wait_s.{job.job_class}", queue_wait)
             obs_flight.FLIGHT.record(
                 "start", job=job.id, tenant=job.tenant,
-                trace_id=job.trace_id,
+                trace_id=job.trace_id, job_key=job.job_key,
                 queue_wait_s=(round(queue_wait, 6)
                               if queue_wait is not None else None))
             if job.job_key:
@@ -760,7 +760,7 @@ class JobScheduler:
                       "ok": bool(result.get("ok"))})
             obs_flight.FLIGHT.record(
                 "done", job=job.id, tenant=job.tenant,
-                trace_id=job.trace_id,
+                trace_id=job.trace_id, job_key=job.job_key,
                 ok=bool(result.get("ok")),
                 exec_wall_s=round(exec_wall, 6))
             REGISTRY.observe("serve_exec_wall_s", exec_wall)
@@ -797,6 +797,13 @@ class JobScheduler:
             # the only place a new calibration epoch may open (jobs
             # in flight keep their r17 pinned rates)
             self._drift_epoch_tick()
+            # r23 forensics: the response frame names its trace id, so
+            # the fleet assembler correlates shard responses (and
+            # journal-deduped replays, which reuse the recorded frame)
+            # without guessing; observability-only — FASTA bytes are
+            # untouched
+            if isinstance(result, dict) and result.get("ok"):
+                result.setdefault("trace_id", job.trace_id)
             # terminal record BEFORE the client rendezvous: once the
             # caller sees the result, any crash must replay it from
             # the journal, not re-run the job

@@ -66,6 +66,29 @@ def eprint(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
+#: r23 bounded forensic reads: hard caps a ``journal_query`` /
+#: ``trace_query`` response never exceeds, whatever the client asked
+#: for — a forensic scan must not ship a multi-GB journal in one frame
+JOURNAL_QUERY_MAX_RECORDS = 1024
+JOURNAL_QUERY_MAX_BYTES = 8 << 20
+TRACE_QUERY_MAX_EVENTS = 4096
+
+
+def _key_filter_match(rec_key, job_key: str = None,
+                      prefix: str = None) -> bool:
+    """Does a record tagged ``rec_key`` belong to the asked-for job?
+    ``job_key`` matches the key itself plus its r20/r21 derived family
+    (``<key>-shard-<i>of<k>[-r<n>]``); ``prefix`` is a raw string
+    prefix for callers that already hold a derived key."""
+    if not isinstance(rec_key, str):
+        return False
+    if job_key is not None and (
+            rec_key == job_key
+            or rec_key.startswith(job_key + "-shard-")):
+        return True
+    return prefix is not None and rec_key.startswith(prefix)
+
+
 class PolishServer:
     def __init__(self, socket_path: str, max_queue: int = None,
                  max_jobs: int = None, idle_timeout: float = None):
@@ -220,9 +243,34 @@ class PolishServer:
             doc["prometheus"] = export.prometheus_text(snap)
         return doc
 
+    @staticmethod
+    def _clock_anchors() -> dict:
+        """Wall-clock anchors every forensic frame carries (r23): the
+        daemon's wall time at answer (the collector's offset-probe
+        sample) and the wall time of its trace epoch (lifts monotonic
+        flight/trace timestamps onto the wall clock).  Rendering
+        only — never control flow or bytes."""
+        return {"wall_t": round(obs_trace.wall_now(), 6),
+                "trace_epoch_wall":
+                    round(obs_trace.epoch_wall(), 6)}
+
+    def _capture_doc(self) -> dict:
+        """r23 capture depths: how much forensic memory this daemon
+        still holds — the flight ring's rollover counter, the per-job
+        trace index's eviction counter, and the journal depth — so a
+        fleet assembler can warn when a ring rolled over mid-job
+        instead of presenting a partial lineage as complete."""
+        return {
+            "flight": obs_flight.FLIGHT.stats(),
+            "trace": obs_trace.TRACER.capture_stats(),
+            "journal": self._journal_doc(),
+        }
+
     def _flight_doc(self, req: dict) -> dict:
         """The live flight-recorder view (``flight`` op): ring stats
-        plus events — optionally filtered to one job (``job``) or the
+        plus events — optionally filtered to one job (``job``), an
+        idempotence-key family (``job_key``, matching the key and its
+        derived shard/rebalance keys), an exact ``trace_id``, or the
         newest N (``last``); with ``job`` the bounded per-job trace
         slice rides along for timeline rendering."""
         try:
@@ -232,15 +280,136 @@ class PolishServer:
         except (TypeError, ValueError):
             return protocol.error_frame(
                 "bad_request", "flight: job/last must be integers")
+        job_key = req.get("job_key")
+        trace_id = req.get("trace_id")
+        if (job_key is not None and not isinstance(job_key, str)) or \
+                (trace_id is not None
+                 and not isinstance(trace_id, str)):
+            return protocol.error_frame(
+                "bad_request",
+                "flight: job_key/trace_id must be strings")
         doc = {
             "ok": True,
             "pid": os.getpid(),
             "identity": self._identity(),
             "ring": obs_flight.FLIGHT.stats(),
-            "events": obs_flight.FLIGHT.snapshot(job=job, last=last),
+            "events": obs_flight.FLIGHT.snapshot(
+                job=job, last=last, job_key=job_key,
+                trace_id=trace_id),
         }
+        doc.update(self._clock_anchors())
         if job is not None:
             doc["job_trace"] = obs_trace.TRACER.job_slice(job)
+        return doc
+
+    def _journal_query_doc(self, req: dict) -> dict:
+        """Bounded read-only journal slice (r23 ``journal_query``).
+        The ask MUST carry a key filter (``job_key``, matching the
+        key and its derived shard/rebalance family, or a raw
+        ``job_key_prefix``) and ``max_records`` — an unbounded ask is
+        a ``bad_request`` by contract — and the response is further
+        capped at JOURNAL_QUERY_MAX_RECORDS /
+        JOURNAL_QUERY_MAX_BYTES.  ``done`` records have their result
+        body slimmed (the recorded FASTA stays on disk; only its size
+        ships).  Scans the file with the torn-tail-tolerant reader —
+        the live append handle is never touched, so the op is
+        read-only by construction."""
+        job_key = req.get("job_key")
+        prefix = req.get("job_key_prefix")
+        if not (isinstance(job_key, str) and job_key) and \
+                not (isinstance(prefix, str) and prefix):
+            return protocol.error_frame(
+                "bad_request",
+                "journal_query requires a job_key or "
+                "job_key_prefix filter (unbounded reads are "
+                "refused)")
+        try:
+            max_records = int(req.get("max_records"))
+        except (TypeError, ValueError):
+            max_records = 0
+        if max_records <= 0:
+            return protocol.error_frame(
+                "bad_request",
+                "journal_query requires max_records > 0 "
+                "(unbounded reads are refused)")
+        max_records = min(max_records, JOURNAL_QUERY_MAX_RECORDS)
+        try:
+            max_bytes = int(req.get("max_bytes",
+                                    JOURNAL_QUERY_MAX_BYTES))
+        except (TypeError, ValueError):
+            max_bytes = JOURNAL_QUERY_MAX_BYTES
+        max_bytes = min(max(1, max_bytes), JOURNAL_QUERY_MAX_BYTES)
+        base = {"ok": True, "pid": os.getpid(),
+                "identity": self._identity()}
+        base.update(self._clock_anchors())
+        if self._journal is None:
+            return dict(base, enabled=False, records=[],
+                        complete=True, matched=0)
+        records, truncated = serve_journal.scan(self._journal.path)
+
+        def _slim(rec: dict) -> dict:
+            rec = dict(rec)
+            res = rec.get("result")
+            if isinstance(res, dict):
+                slim = {k: res.get(k) for k in
+                        ("ok", "job_id", "n_sequences", "wall_s",
+                         "trace_id") if k in res}
+                fb = res.get("fasta_b64")
+                if isinstance(fb, str):
+                    slim["fasta_bytes"] = \
+                        len(fb) * 3 // 4 - fb[-2:].count("=")
+                rec["result"] = slim
+            return rec
+
+        sel = [_slim(rec) for rec in records
+               if _key_filter_match(rec.get("job_key"),
+                                    job_key=(job_key or None),
+                                    prefix=(prefix or None))]
+        matched = len(sel)
+        complete = matched <= max_records
+        sel = sel[-max_records:]
+        out, used = [], 0
+        import json as _json
+        for rec in sel:
+            n = len(_json.dumps(rec, separators=(",", ":")))
+            if out and used + n > max_bytes:
+                complete = False
+                break
+            out.append(rec)
+            used += n
+        return dict(base, enabled=True, path=self._journal.path,
+                    records=out, scan_truncated=truncated,
+                    complete=complete, matched=matched)
+
+    def _trace_query_doc(self, req: dict) -> dict:
+        """Bounded per-job trace slice (r23 ``trace_query``): the
+        same events ``submit --trace`` rides on the response frame,
+        readable after the fact by a fleet assembler.  Requires
+        ``job`` and ``max_events`` (capped at
+        TRACE_QUERY_MAX_EVENTS); read-only against the tracer's
+        bounded LRU index."""
+        try:
+            job = int(req.get("job"))
+        except (TypeError, ValueError):
+            return protocol.error_frame(
+                "bad_request", "trace_query requires a job id")
+        try:
+            max_events = int(req.get("max_events"))
+        except (TypeError, ValueError):
+            max_events = 0
+        if max_events <= 0:
+            return protocol.error_frame(
+                "bad_request",
+                "trace_query requires max_events > 0 "
+                "(unbounded reads are refused)")
+        max_events = min(max_events, TRACE_QUERY_MAX_EVENTS)
+        evs = obs_trace.TRACER.job_slice(job)
+        doc = {"ok": True, "pid": os.getpid(),
+               "identity": self._identity(), "job": job,
+               "complete": len(evs) <= max_events,
+               "events": evs[-max_events:],
+               "capture": obs_trace.TRACER.capture_stats()}
+        doc.update(self._clock_anchors())
         return doc
 
     def _explain_doc(self, req: dict) -> dict:
@@ -282,7 +451,7 @@ class PolishServer:
         from racon_tpu.tpu import executor as device_executor
 
         q = self.scheduler.snapshot()
-        return {
+        doc = {
             "ok": True,
             "status": "draining" if q["draining"] else "ok",
             "pid": os.getpid(),
@@ -300,7 +469,13 @@ class PolishServer:
             "journal": self._journal_doc(),
             "recovered_jobs": self.recovered["requeued"],
             "recovery": dict(self.recovered),
+            # r23 fleet forensics: capture depths + clock anchors, so
+            # `inspect --fleet` estimates this daemon's clock offset
+            # from the probe round trip and warns on rollover
+            "capture": self._capture_doc(),
         }
+        doc.update(self._clock_anchors())
+        return doc
 
     def _cache_health(self) -> dict:
         """The result cache's cheap health block (r18): hit ratio +
@@ -395,6 +570,10 @@ class PolishServer:
                 resp = self._health_doc()
             elif op == "flight":
                 resp = self._flight_doc(req)
+            elif op == "journal_query":
+                resp = self._journal_query_doc(req)
+            elif op == "trace_query":
+                resp = self._trace_query_doc(req)
             elif op == "explain":
                 resp = self._explain_doc(req)
             elif op == "cancel":

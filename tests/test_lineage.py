@@ -1,0 +1,880 @@
+"""Fleet forensics (r23): distributed trace assembly, lineage
+reconstruction, and clock-aligned cross-daemon timelines.
+
+Coverage map:
+
+* **pure units** — the derived-key grammar walker, clock alignment,
+  the lineage DAG builder (completeness, winners, typed edges,
+  rollover/unreachable warnings) and the merged Perfetto doc, all
+  over synthetic collection documents;
+* **clock-skew invariance** — injecting fixed per-daemon skews into
+  a collection doc (anchors + journal walls shifted together with a
+  perfect offset estimate, exactly what a skewed-but-well-estimated
+  daemon looks like) must leave the rendered event ORDER unchanged:
+  offsets are rendering-only by construction, and this pins that
+  the rendering itself is skew-invariant;
+* **wire bounds** — ``journal_query``/``trace_query`` refuse
+  unbounded asks (``bad_request``), clamp to the server caps, slim
+  ``done`` result bodies, and stay read-only;
+* **satellite plumbing** — ``flight`` job_key/trace_id filters, the
+  tracer's capture/eviction stats, and the r23 trace-context
+  adoption: a context-less routed submit reaches every backend with
+  the mega-job key as its trace id (in-proc router + stub
+  backends);
+* **chaos matrix (slow)** — a 3-shard scatter across 3 real daemons
+  with an aggressive rebalance watchdog and one backend armed to
+  SIGKILL at admission: the gather still matches the one-shot CLI
+  bytes, and ``assemble`` against the half-dead fleet reconstructs
+  a COMPLETE lineage (every journaled derived key accounted,
+  exactly one winner per shard) with the dead backend flagged, a
+  skew-invariant timeline, a loadable merged Perfetto doc, and
+  ``racon-tpu inspect --fleet`` exiting 0.
+"""
+
+import base64
+import copy
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from racon_tpu.obs import assemble  # noqa: E402
+from racon_tpu.obs import flight as obs_flight  # noqa: E402
+from racon_tpu.obs import trace as obs_trace  # noqa: E402
+from racon_tpu.serve import client  # noqa: E402
+from racon_tpu.serve import journal as serve_journal  # noqa: E402
+from racon_tpu.serve import protocol  # noqa: E402
+from racon_tpu.serve import router  # noqa: E402
+from racon_tpu.serve import server as serve_server  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# pure units: key grammar + clock alignment
+# ---------------------------------------------------------------------------
+
+def test_parse_key_grammar():
+    assert assemble.parse_key("k-shard-0of3") == {
+        "base": "k", "shard": 0, "count": 3, "attempt": 0}
+    assert assemble.parse_key("k-shard-2of3-r1") == {
+        "base": "k", "shard": 2, "count": 3, "attempt": 1}
+    # nested base containing the grammar itself still parses to the
+    # OUTERMOST suffix (greedy base)
+    assert assemble.parse_key("a-shard-0of2-r1-shard-1of4") == {
+        "base": "a-shard-0of2-r1", "shard": 1, "count": 4,
+        "attempt": 0}
+    # digest-folded long bases keep the grammar
+    folded = "sc-" + "0" * 32 + "-shard-5of8-r2"
+    assert assemble.parse_key(folded)["shard"] == 5
+    for not_derived in ("plain", "k-shard-xofy", "k-shard-1of2-rx",
+                        None, 7):
+        assert assemble.parse_key(not_derived) is None
+
+
+def test_aligned_wall_pure():
+    d = {"trace_epoch_wall": 1000.0, "clock_offset_s": 2.5}
+    # flight/trace timestamps lift through the epoch anchor, then
+    # the offset maps onto the collector clock
+    assert assemble.aligned_wall(d, 3.0) == pytest.approx(1000.5)
+    # journal timestamps are already wall-clock
+    assert assemble.aligned_wall(d, 1004.0, wall=True) == \
+        pytest.approx(1001.5)
+    # missing anchors degrade to None (pre-r23 daemon), missing
+    # offset to raw alignment
+    assert assemble.aligned_wall({"clock_offset_s": 1.0}, 3.0) is None
+    assert assemble.aligned_wall({"trace_epoch_wall": 10.0}, 3.0) == \
+        pytest.approx(13.0)
+    assert assemble.aligned_wall(d, None) is None
+
+
+def _synthetic_collection():
+    """A 3-daemon collection doc: router + one live backend (skewed
+    +2 s, ring rolled over) + one dead backend — a scattered job
+    with one rebalance, a failover, winners on r1/shard0 and
+    shard1."""
+    return {
+        "schema": assemble.COLLECT_SCHEMA, "address": "r.sock",
+        "job_key": "mega", "trace_id": None,
+        "daemons": [
+            {"target": "r.sock", "ok": True, "router": True,
+             "pid": 100, "identity": {"daemon_id": "router"},
+             "clock_offset_s": 0.0, "offset_confidence_s": 0.001,
+             "probe_rtt_s": 0.002, "wall_t": 1000.0,
+             "trace_epoch_wall": 990.0,
+             "capture": {"flight": {"dropped": 0},
+                         "trace": {"evicted": 0},
+                         "journal": {"enabled": False}},
+             "flight_events": [
+                 {"kind": "route_scatter", "t": 1.0, "job": 1,
+                  "shards": 2, "trace_id": "mega",
+                  "keys": ["mega-shard-0of2", "mega-shard-1of2"]},
+                 {"kind": "route", "t": 1.1, "job": 1,
+                  "job_key": "mega-shard-0of2", "backend": "b0.sock"},
+                 {"kind": "route", "t": 1.2, "job": 1,
+                  "job_key": "mega-shard-1of2", "backend": "b1.sock"},
+                 {"kind": "route_failover", "t": 2.0, "job": 1,
+                  "job_key": "mega-shard-0of2", "backend": "b0.sock",
+                  "error": "connection reset"},
+                 {"kind": "route", "t": 2.1, "job": 1,
+                  "job_key": "mega-shard-0of2", "backend": "b1.sock"},
+                 {"kind": "route_rebalance", "t": 3.0, "job": 1,
+                  "key": "mega-shard-0of2-r1", "backend": "b1.sock",
+                  "shard": 0, "attempt": 1, "elapsed_s": 2.0,
+                  "threshold_s": 1.0},
+                 {"kind": "route", "t": 3.1, "job": 1,
+                  "job_key": "mega-shard-0of2-r1",
+                  "backend": "b1.sock"},
+                 {"kind": "route_scatter_shard", "t": 4.0, "job": 1,
+                  "key": "mega-shard-0of2-r1", "shard": 0,
+                  "ok": True, "winner": True},
+                 {"kind": "route_scatter_shard", "t": 4.1, "job": 1,
+                  "key": "mega-shard-1of2", "shard": 1, "ok": True,
+                  "winner": True},
+                 {"kind": "route_gather", "t": 4.2, "job": 1,
+                  "shards": 2, "wall_s": 3.2,
+                  "winner_keys": ["mega-shard-0of2-r1",
+                                  "mega-shard-1of2"]},
+             ],
+             "journal": None,
+             "trace_slices": {"1": [
+                 {"name": "route.submit", "ph": "X",
+                  "ts": 1_000_000.0, "dur": 3_200_000.0,
+                  "pid": 100, "tid": 1, "cat": "route"}]}},
+            {"target": "b1.sock", "ok": True, "router": False,
+             "pid": 101, "identity": {"daemon_id": "b1"},
+             "clock_offset_s": 2.0, "offset_confidence_s": 0.002,
+             "probe_rtt_s": 0.004, "wall_t": 1002.0,
+             "trace_epoch_wall": 992.0,
+             "capture": {"flight": {"dropped": 5},
+                         "trace": {"evicted": 0},
+                         "journal": {"enabled": True}},
+             "flight_events": [
+                 {"kind": "admit", "t": 3.2, "job": 7,
+                  "job_key": "mega-shard-0of2-r1",
+                  "trace_id": "mega"},
+                 {"kind": "done", "t": 4.0, "job": 7,
+                  "job_key": "mega-shard-0of2-r1", "ok": True},
+             ],
+             "journal": {"enabled": True, "complete": True,
+                         "scan_truncated": False,
+                         "records": [
+                             {"kind": "done", "t": 996.0,
+                              "job_key": "mega-shard-0of2-r1",
+                              "result": {"ok": True,
+                                         "n_sequences": 3}}]},
+             "trace_slices": {}},
+            {"target": "b0.sock", "ok": False, "router": False,
+             "error": "ServeError: connection refused", "pid": None,
+             "identity": None, "clock_offset_s": None,
+             "offset_confidence_s": None, "probe_rtt_s": None,
+             "wall_t": None, "trace_epoch_wall": None,
+             "capture": None, "flight_events": [], "journal": None,
+             "trace_slices": {}},
+        ]}
+
+
+def test_lineage_synthetic_complete():
+    coll = _synthetic_collection()
+    lin = assemble.build_lineage(coll)
+    assert lin["schema"] == "racon-tpu-lineage-v1"
+    assert lin["job_key"] == "mega"
+    assert lin["shards"] == 2
+    assert lin["complete"], lin
+    assert {n["key"] for n in lin["nodes"]} == {
+        "mega", "mega-shard-0of2", "mega-shard-0of2-r1",
+        "mega-shard-1of2"}
+    # exactly one winner per shard
+    winners = [n for n in lin["nodes"] if n["winner"]]
+    assert sorted(n["shard"] for n in winners) == [0, 1]
+    assert set(lin["winners"]) == {"mega-shard-0of2-r1",
+                                   "mega-shard-1of2"}
+    kinds = {(e["kind"], e["from"], e["to"]) for e in lin["edges"]}
+    assert ("shard", "mega", "mega-shard-0of2") in kinds
+    assert ("shard", "mega", "mega-shard-1of2") in kinds
+    assert ("rebalance", "mega-shard-0of2",
+            "mega-shard-0of2-r1") in kinds
+    assert ("failover", "mega-shard-0of2",
+            "mega-shard-0of2") in kinds
+    assert ("gather", "mega-shard-0of2-r1", "mega") in kinds
+    assert ("gather", "mega-shard-1of2", "mega") in kinds
+    # rollover + unreachable both surface as warnings, not silence
+    assert any("rolled over" in w for w in lin["warnings"])
+    assert any("unreachable" in w for w in lin["warnings"])
+    # the done journal record marks the winning attempt ok
+    n = next(n for n in lin["nodes"]
+             if n["key"] == "mega-shard-0of2-r1")
+    assert n["ok"] and "journal" in n["sources"]
+    # backends attribute from route events and local flight events
+    assert "b1.sock" in n["backends"]
+
+
+def test_lineage_incompleteness_detected():
+    coll = _synthetic_collection()
+    # drop shard 1 everywhere: its attempt key must be flagged
+    for d in coll["daemons"]:
+        d["flight_events"] = [
+            ev for ev in d["flight_events"]
+            if "1of2" not in str(ev.get("key") or "")
+            and "1of2" not in str(ev.get("job_key") or "")]
+        for ev in d["flight_events"]:
+            if "keys" in ev:
+                ev["keys"] = [k for k in ev["keys"] if "1of2" not in k]
+            if "winner_keys" in ev:
+                ev["winner_keys"] = [k for k in ev["winner_keys"]
+                                     if "1of2" not in k]
+    lin = assemble.build_lineage(coll)
+    assert not lin["complete"]
+    assert any("missing shard" in w for w in lin["warnings"])
+    # two winners for one slot is just as incomplete
+    coll2 = _synthetic_collection()
+    for ev in coll2["daemons"][0]["flight_events"]:
+        if ev["kind"] == "route_gather":
+            ev["winner_keys"].append("mega-shard-0of2")
+    lin2 = assemble.build_lineage(coll2)
+    assert not lin2["complete"]
+    assert any("exactly one winning attempt" in w
+               for w in lin2["warnings"])
+
+
+def _inject_skew(daemon: dict, skew_s: float) -> None:
+    """Make one daemon's clock run ``skew_s`` ahead, with a perfect
+    offset estimate: every wall-anchored field shifts together with
+    the estimated offset — exactly what a skewed daemon looks like
+    to a collector whose probes measured the skew correctly."""
+    for f in ("wall_t", "trace_epoch_wall"):
+        if isinstance(daemon.get(f), (int, float)):
+            daemon[f] += skew_s
+    daemon["clock_offset_s"] = \
+        (daemon.get("clock_offset_s") or 0.0) + skew_s
+    for rec in (daemon.get("journal") or {}).get("records", ()):
+        if isinstance(rec.get("t"), (int, float)):
+            rec["t"] += skew_s
+
+
+def test_clock_skew_order_invariance():
+    coll = _synthetic_collection()
+    base_rows = [(lane, text) for _, lane, text
+                 in assemble._timeline_rows(coll)]
+    assert base_rows, "synthetic doc rendered no rows"
+    # the backend's admit (daemon t=3.2, epoch 992, offset +2 ->
+    # collector 993.2) interleaves between the router's rebalance
+    # (992+3.0) and shard-win (992+4.0) decisions
+    order = [text.split()[0] for _, text in
+             [(None, t) for _, t in base_rows]]
+    i_reb = order.index("route_rebalance")
+    i_admit = order.index("admit")
+    i_win = order.index("route_scatter_shard")
+    assert i_reb < i_admit < i_win
+    for skews in ((5.0, 0.0), (0.0, -3.25), (120.0, 7.5)):
+        skewed = copy.deepcopy(coll)
+        _inject_skew(skewed["daemons"][0], skews[0])
+        _inject_skew(skewed["daemons"][1], skews[1])
+        rows = [(lane, text) for _, lane, text
+                in assemble._timeline_rows(skewed)]
+        assert rows == base_rows, (
+            f"per-daemon skews {skews} changed the rendered order")
+    # the rendered text carries the offset annotation
+    lin = assemble.build_lineage(coll)
+    text = assemble.render_fleet_timeline(lin, coll)
+    assert "offset +2.000s ±0.002s" in text
+    assert "UNREACHABLE" in text
+
+
+def test_merged_trace_doc_shape():
+    coll = _synthetic_collection()
+    lin = assemble.build_lineage(coll)
+    doc = assemble.merged_trace_doc(lin, coll)
+    json.loads(json.dumps(doc))     # Perfetto-loadable: plain JSON
+    evs = doc["traceEvents"]
+    metas = [e for e in evs if e.get("ph") == "M"
+             and e["name"] == "process_name"]
+    assert len(metas) == 3          # every daemon is a process
+    names = {e["args"]["name"] for e in metas}
+    assert "r.sock (router)" in names and "b1.sock" in names
+    # flow arrows: router route decisions open ph:"s", backend
+    # admits close ph:"f" under the crc32(key) id
+    starts = [e for e in evs if e.get("ph") == "s"]
+    finishes = [e for e in evs if e.get("ph") == "f"]
+    assert starts and finishes
+    fid = assemble._flow_id("mega-shard-0of2-r1")
+    assert any(e["id"] == fid for e in starts)
+    assert any(e["id"] == fid for e in finishes)
+    # captured spans survive with re-based timestamps; every ts is
+    # relative to the global base (>= 0)
+    assert any(e.get("ph") == "X" and e["name"] == "route.submit"
+               for e in evs)
+    assert all(e.get("ts", 0) >= 0 for e in evs)
+    assert doc["lineage"] is lin
+
+
+# ---------------------------------------------------------------------------
+# satellite plumbing: flight filters, tracer stats, wire bounds
+# ---------------------------------------------------------------------------
+
+def test_flight_snapshot_key_filters():
+    fl = obs_flight.FlightRecorder(maxlen=64)
+    fl.record("admit", job=1, job_key="megak-shard-0of2",
+              trace_id="megak")
+    fl.record("admit", job=2, job_key="megak-shard-1of2",
+              trace_id="megak")
+    fl.record("admit", job=3, job_key="megakother", trace_id="zzz")
+    fl.record("route_scatter_shard", job=4, key="megak-shard-0of2")
+    fl.record("route_gather", job=4, winner_key="megak-shard-0of2")
+    # job_key matches the key itself + its derived family across the
+    # job_key/key/winner_key fields — but NOT mere prefixes
+    fam = fl.snapshot(job_key="megak")
+    assert [ev["job"] for ev in fam] == [1, 2, 4, 4]
+    assert fl.snapshot(job_key="megakother")[0]["job"] == 3
+    assert fl.snapshot(trace_id="megak") == fam[:2]
+    assert fl.snapshot(trace_id="nope") == []
+    # filters compose with last=N (applied after)
+    assert [ev["job"] for ev in fl.snapshot(job_key="megak",
+                                            last=1)] == [4]
+
+
+def test_tracer_capture_stats_eviction():
+    tr = obs_trace.Tracer()
+    tr.enable_job_capture()
+    t0 = obs_trace.now()
+    for j in range(tr._JOB_MAX + 3):
+        tr.add_span("s", t0, t0 + 0.001, jobs=[j])
+    st = tr.capture_stats()
+    assert st["job_capture"] is True
+    assert st["jobs"] == tr._JOB_MAX
+    assert st["evicted"] == 3
+    assert st["max_jobs"] == tr._JOB_MAX
+    tr.clear()
+    assert tr.capture_stats()["evicted"] == 0
+
+
+def _bare_server(tmp, journal_file=None):
+    """A PolishServer shell for exercising the r23 read-only query
+    docs without a scheduler or socket."""
+    srv = serve_server.PolishServer.__new__(serve_server.PolishServer)
+    srv.socket_path = os.path.join(tmp, "d.sock")
+    srv._journal = None
+    if journal_file is not None:
+        srv._journal = serve_journal.JobJournal(journal_file)
+    return srv
+
+
+def test_journal_query_bounds(tmp_path):
+    jpath = str(tmp_path / "d.journal")
+    srv = _bare_server(str(tmp_path), journal_file=jpath)
+    fasta = base64.b64encode(b">x\nACGT\n" * 50).decode()
+    for i in range(6):
+        srv._journal.append("admit", job=i, job_key=f"jq-shard-{i}of6")
+        srv._journal.append(
+            "done", job=i, job_key=f"jq-shard-{i}of6",
+            result={"ok": True, "job_id": i, "n_sequences": 1,
+                    "wall_s": 0.5, "fasta_b64": fasta})
+    srv._journal.append("admit", job=99, job_key="unrelated")
+
+    # unbounded asks are refused
+    for bad in ({}, {"job_key": "jq"}, {"max_records": 5},
+                {"job_key": "jq", "max_records": 0},
+                {"job_key": "jq", "max_records": "all"}):
+        doc = srv._journal_query_doc(bad)
+        assert not doc["ok"]
+        assert doc["error"]["code"] == "bad_request"
+
+    # a bounded key-family ask: derived keys match, result bodies are
+    # slimmed (fasta length, never fasta bytes), anchors present
+    doc = srv._journal_query_doc({"job_key": "jq",
+                                  "max_records": 100})
+    assert doc["ok"] and doc["enabled"] and doc["complete"]
+    assert doc["matched"] == 12
+    assert {r["job_key"] for r in doc["records"]} == {
+        f"jq-shard-{i}of6" for i in range(6)}
+    done = [r for r in doc["records"] if r["kind"] == "done"]
+    assert all("fasta_b64" not in r["result"] for r in done)
+    assert all(r["result"]["fasta_bytes"] ==
+               len(base64.b64decode(fasta)) for r in done)
+    assert isinstance(doc["wall_t"], float)
+    assert isinstance(doc["trace_epoch_wall"], float)
+
+    # record cap -> newest records, complete False
+    doc = srv._journal_query_doc({"job_key": "jq", "max_records": 3})
+    assert len(doc["records"]) == 3 and not doc["complete"]
+    assert doc["matched"] == 12
+
+    # byte budget clips too
+    doc = srv._journal_query_doc({"job_key": "jq",
+                                  "max_records": 100,
+                                  "max_bytes": 200})
+    assert not doc["complete"] and len(doc["records"]) >= 1
+
+    # raw prefix filter for callers holding a derived key
+    doc = srv._journal_query_doc({"job_key_prefix": "unrel",
+                                  "max_records": 10})
+    assert doc["matched"] == 1
+
+    # journal-off daemons answer enabled=False, still ok
+    srv2 = _bare_server(str(tmp_path))
+    doc = srv2._journal_query_doc({"job_key": "jq",
+                                   "max_records": 10})
+    assert doc["ok"] and doc["enabled"] is False
+    assert doc["records"] == [] and doc["complete"]
+
+
+def test_trace_query_bounds(tmp_path):
+    srv = _bare_server(str(tmp_path))
+    obs_trace.TRACER.enable_job_capture()
+    try:
+        t0 = obs_trace.now()
+        for i in range(5):
+            obs_trace.TRACER.add_span(f"s{i}", t0 + i * 0.001,
+                                      t0 + i * 0.001 + 0.0005,
+                                      jobs=[424242])
+        for bad in ({}, {"job": "x", "max_events": 5},
+                    {"job": 424242}, {"job": 424242,
+                                      "max_events": 0}):
+            doc = srv._trace_query_doc(bad)
+            assert not doc["ok"]
+            assert doc["error"]["code"] == "bad_request"
+        doc = srv._trace_query_doc({"job": 424242, "max_events": 100})
+        assert doc["ok"] and doc["complete"]
+        assert len(doc["events"]) == 5
+        assert doc["capture"]["job_capture"] is True
+        assert isinstance(doc["trace_epoch_wall"], float)
+        doc = srv._trace_query_doc({"job": 424242, "max_events": 2})
+        assert len(doc["events"]) == 2 and not doc["complete"]
+        # unknown jobs are an empty, complete slice — not an error
+        doc = srv._trace_query_doc({"job": 555555, "max_events": 5})
+        assert doc["ok"] and doc["events"] == [] and doc["complete"]
+    finally:
+        obs_trace.TRACER.clear()
+
+
+# ---------------------------------------------------------------------------
+# in-proc router + stub backends: trace-context adoption and the
+# full assemble path (no real daemons, tier-1 speed)
+# ---------------------------------------------------------------------------
+
+def _stub_backend(path, behavior):
+    s = socket.socket(socket.AF_UNIX)
+    s.bind(path)
+    s.listen(16)
+    s.settimeout(0.2)
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            try:
+                conn, _ = s.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                req = protocol.recv_frame(conn)
+                if req is not None:
+                    protocol.send_frame(conn, behavior(req))
+            except Exception:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    threading.Thread(target=loop, daemon=True).start()
+    return stop, s
+
+
+def _recording_behavior(name, seen):
+    """Stub submit answers record (backend, shard, key, trace_ctx)
+    so trace-context propagation is assertable per sub-submit."""
+    def behavior(req):
+        if req["op"] == "health":
+            return {"ok": True, "status": "ok", "accepting": True,
+                    "queue_depth": 0, "running": 0, "pid": 1}
+        if req["op"] == "submit":
+            shard = (req["job"].get("shard") or [0, 1])[0]
+            seen.append((name, shard, req.get("job_key"),
+                         req.get("trace_context")))
+            fa = f">s{shard}\nACGT\n".encode()
+            return {"ok": True, "job_id": 100 + shard,
+                    "fasta_b64": base64.b64encode(fa).decode(),
+                    "wall_s": 0.01, "n_sequences": 1,
+                    "trace_id": req.get("trace_context"),
+                    "report": {"who": name}}
+        return {"ok": True}
+    return behavior
+
+
+@pytest.fixture()
+def inproc_router(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_ROUTE_PROBE_S", "0.1")
+    monkeypatch.delenv("RACON_TPU_SCATTER_MIN_WALL_S", raising=False)
+    monkeypatch.delenv("RACON_TPU_SCATTER_REBALANCE", raising=False)
+    tmp = tempfile.mkdtemp(prefix="rtlin_ip_", dir="/tmp")
+    seen = []
+    stops, paths = [], []
+    for i in range(3):
+        path = os.path.join(tmp, f"b{i}.sock")
+        stop, sock = _stub_backend(
+            path, _recording_behavior(f"B{i}", seen))
+        stops.append((stop, sock))
+        paths.append(path)
+    rsock = os.path.join(tmp, "r.sock")
+    obs_flight._reset_for_tests()
+    r = router.FleetRouter(rsock, paths)
+    threading.Thread(target=r.serve_forever, daemon=True).start()
+    deadline = time.monotonic() + 20
+    while not os.path.exists(rsock) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(rsock), "router socket never bound"
+    yield r, rsock, paths, seen
+    for stop, sock in stops:
+        stop.set()
+        sock.close()
+    r.request_stop()
+
+
+def test_router_trace_context_adoption(inproc_router):
+    r, rsock, paths, seen = inproc_router
+    spec = {"sequences": "/nope", "overlaps": "/nope",
+            "targets": "/nope"}
+    # r23 bugfix: a context-less scattered submit reaches EVERY
+    # backend with the mega-job key adopted as the trace context
+    resp = client.submit(rsock, spec, job_key="adoptk", shards=3)
+    assert resp["ok"], resp
+    assert {(s, k, t) for _, s, k, t in seen} == {
+        (i, f"adoptk-shard-{i}of3", "adoptk") for i in range(3)}
+    # per-shard rows carry the wire trace id
+    assert [p["trace_id"] for p in
+            resp["report"]["per_shard"]] == ["adoptk"] * 3
+    # an explicit client context still wins over adoption
+    seen.clear()
+    resp = client.submit(rsock, spec, job_key="adoptk2", shards=2,
+                         trace_context="client-ctx")
+    assert resp["ok"]
+    assert {t for _, _, _, t in seen} == {"client-ctx"}
+    # an invalid context is refused before any placement
+    bad = client.submit(rsock, spec, job_key="adoptk3",
+                        trace_context="bad context!")
+    assert not bad["ok"]
+    assert bad["error"]["code"] == "bad_request"
+    # router forensic parity: route events are trace-tagged and a
+    # traced submit carries the router's own capture alongside the
+    # backend's
+    evs = client.flight(rsock, trace_id="adoptk")["events"]
+    assert {"route_scatter", "route", "route_scatter_shard",
+            "route_gather"} <= {e["kind"] for e in evs}
+    traced = client.submit(rsock, spec, job_key="adoptk4", shards=2,
+                           want_trace=True)
+    assert traced["ok"]
+    assert traced["router_pid"] == os.getpid()
+    assert any(e["kind"] == "route_scatter"
+               for e in traced["router_flight_events"])
+    assert any(e.get("name") == "route.submit"
+               for e in traced["router_trace_events"])
+
+
+def test_assemble_inproc_fleet(inproc_router, capsys):
+    r, rsock, paths, seen = inproc_router
+    spec = {"sequences": "/nope", "overlaps": "/nope",
+            "targets": "/nope"}
+    resp = client.submit(rsock, spec, job_key="asmk", shards=3)
+    assert resp["ok"], resp
+    collection, lineage = assemble.assemble(rsock, job_key="asmk")
+    # discovery walked router -> backends
+    assert [d["target"] for d in collection["daemons"]] == \
+        [rsock] + paths
+    router_row = collection["daemons"][0]
+    assert router_row["router"] and router_row["ok"]
+    # offset estimation against the live router: near-zero offset,
+    # tight confidence (same host, same clock)
+    assert abs(router_row["clock_offset_s"]) < 5.0
+    assert router_row["offset_confidence_s"] < 5.0
+    assert router_row["capture"]["flight"]["capacity"] > 0
+    # the lineage is complete from the router's records alone (the
+    # stubs answer no forensic ops — like pre-r23 daemons)
+    assert lineage["complete"], lineage["warnings"]
+    assert lineage["shards"] == 3
+    winners = [n for n in lineage["nodes"] if n["winner"]]
+    assert sorted(n["shard"] for n in winners) == [0, 1, 2]
+    assert {n["key"] for n in lineage["nodes"]} == {
+        "asmk"} | {f"asmk-shard-{i}of3" for i in range(3)}
+    # the CLI surface over the same fleet: exit 0 on a complete
+    # lineage, rendered lanes + DAG edges on stdout
+    from racon_tpu.serve import inspect as serve_inspect
+    rc = serve_inspect.main(["--fleet", rsock, "--job-key", "asmk"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "complete" in out and "lane router" in out
+    assert "edge shard" in out and "edge gather" in out
+
+
+def test_assemble_requires_a_key():
+    with pytest.raises(ValueError):
+        assemble.assemble("/nonexistent.sock")
+
+
+# ---------------------------------------------------------------------------
+# chaos forensics matrix (slow): real daemons, rebalance + SIGKILL
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_tmp():
+    with tempfile.TemporaryDirectory(prefix="rtlin_",
+                                     dir="/tmp") as d:
+        yield d
+
+
+@pytest.fixture(scope="module")
+def dataset(serve_tmp):
+    from racon_tpu.tools import simulate
+
+    return simulate.simulate(os.path.join(serve_tmp, "data"),
+                             genome_len=8_000, coverage=5,
+                             read_len=800, seed=21, ont=True)
+
+
+def _serve_env(serve_tmp, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "RACON_TPU_CACHE_DIR": os.path.join(serve_tmp, "cache"),
+        "RACON_TPU_CLI_PREWARM": "0",
+        "RACON_TPU_RATE_POA_DEV": "0.30",
+        "RACON_TPU_RATE_POA_CPU": "2.0",
+        "RACON_TPU_RATE_ALIGN_DEV": "1100",
+        "RACON_TPU_RATE_ALIGN_CPU": "4.0",
+        "RACON_TPU_RATE_ALIGN_WFA_DEV": "700",
+        "RACON_TPU_RATE_ALIGN_WFA_CPU": "1.0",
+        "RACON_TPU_POA_MEGABATCH": "1",
+    })
+    env.pop("RACON_TPU_TRACE", None)
+    env.pop("RACON_TPU_METRICS_JSON", None)
+    env.pop("RACON_TPU_FAULT", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+@pytest.fixture(scope="module")
+def golden(dataset, serve_tmp):
+    reads, paf, draft = dataset
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "4", "-c", "1",
+         "--tpualigner-batches", "1", reads, paf, draft],
+        cwd=REPO_ROOT, capture_output=True,
+        env=_serve_env(serve_tmp), timeout=600)
+    assert run.returncode == 0, run.stderr.decode()
+    assert run.stdout.startswith(b">")
+    return run.stdout
+
+
+def _spec(dataset):
+    reads, paf, draft = dataset
+    return {"sequences": reads, "overlaps": paf, "targets": draft,
+            "threads": 4, "tpu_poa_batches": 1,
+            "tpu_aligner_batches": 1}
+
+
+def _wait_listening(proc, sock_path, log_path, what):
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            with open(log_path) as fh:
+                raise AssertionError(
+                    f"{what} died at startup: " + fh.read())
+        if os.path.exists(sock_path):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock_path)
+            except OSError:
+                pass
+            else:
+                return
+            finally:
+                probe.close()
+        time.sleep(0.2)
+    proc.kill()
+    raise AssertionError(f"{what} socket never came up")
+
+
+def _start_server(serve_tmp, name, args=(), extra_env=None):
+    sock_path = os.path.join(serve_tmp, name + ".sock")
+    log_path = os.path.join(serve_tmp, name + ".log")
+    log = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve",
+         "--socket", sock_path, *args],
+        cwd=REPO_ROOT, stdout=log, stderr=log,
+        env=_serve_env(serve_tmp, extra_env))
+    log.close()
+    _wait_listening(proc, sock_path, log_path, "server " + name)
+    return proc, sock_path, log_path
+
+
+def _start_router(serve_tmp, name, backends, args=(),
+                  extra_env=None):
+    sock_path = os.path.join(serve_tmp, name + ".sock")
+    log_path = os.path.join(serve_tmp, name + ".log")
+    log = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "route",
+         "--socket", sock_path,
+         "--backends", ",".join(backends), *args],
+        cwd=REPO_ROOT, stdout=log, stderr=log,
+        env=_serve_env(serve_tmp, extra_env))
+    log.close()
+    _wait_listening(proc, sock_path, log_path, "router " + name)
+    return proc, sock_path, log_path
+
+
+def _stop(proc, sock_path):
+    if proc.poll() is None:
+        try:
+            client.admin(sock_path, "shutdown")
+        except client.ServeError:
+            proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _done_keys(*sock_paths):
+    keys = []
+    for sock_path in sock_paths:
+        records, _ = serve_journal.scan(
+            serve_journal.journal_path(sock_path))
+        keys.extend(rec["job_key"] for rec in records
+                    if rec.get("kind") == "done"
+                    and rec.get("job_key"))
+    return keys
+
+
+@pytest.mark.slow
+def test_chaos_forensics_matrix(serve_tmp, dataset, golden):
+    """The r23 acceptance pin: a 3-shard scattered job under an
+    aggressive rebalance watchdog with one backend armed to SIGKILL
+    the moment it admits a job.  The gather still matches the
+    one-shot CLI bytes; ``assemble`` against the half-dead fleet
+    reconstructs a COMPLETE lineage — every journaled derived key
+    accounted, exactly one winner per shard, the dead backend
+    flagged rather than silently absent — the clock-skew-injected
+    timeline keeps its order, the merged Perfetto doc loads, and
+    ``racon-tpu inspect --fleet`` exits 0."""
+    proc_b, b_sock, _ = _start_server(serve_tmp, "lin-b")
+    proc_c, c_sock, _ = _start_server(serve_tmp, "lin-c")
+    proc_a, a_sock, _ = _start_server(
+        serve_tmp, "lin-a",
+        extra_env={"RACON_TPU_FAULT": "post-admit:1"})
+    proc_r, r_sock, _ = _start_router(
+        serve_tmp, "lin-r", [b_sock, c_sock, a_sock],
+        extra_env={"RACON_TPU_ROUTE_PROBE_S": "0.1",
+                   "RACON_TPU_SCATTER_REBALANCE": "0.01"})
+    key = "lineage-chaos"
+    socks = (b_sock, c_sock, a_sock)
+    try:
+        resp = client.submit(r_sock, _spec(dataset), job_key=key,
+                             shards=3)
+        assert resp["ok"], resp
+        assert base64.b64decode(resp["fasta_b64"]) == golden, (
+            "gather through rebalance + SIGKILL diverged from the "
+            "one-shot CLI bytes")
+        assert proc_a.wait(timeout=60) == -signal.SIGKILL
+        doc = client.route_status(r_sock)
+        assert doc["counters"].get("route_rebalance", 0) >= 1
+        # every shard's winner carries the adopted fleet trace id
+        for p in resp["report"]["per_shard"]:
+            assert p["trace_id"] == key, p
+
+        # -- the tentpole: fleet assembly against the live fleet ----
+        collection, lineage = assemble.assemble(r_sock, job_key=key)
+        assert lineage["schema"] == "racon-tpu-lineage-v1"
+        assert lineage["complete"], lineage["warnings"]
+        assert lineage["shards"] == 3
+        # every derived key any journal recorded is accounted for
+        node_keys = {n["key"] for n in lineage["nodes"]}
+        done = [k for k in _done_keys(*socks)
+                if k == key or k.startswith(key + "-shard-")]
+        assert done and set(done) <= node_keys, (done, node_keys)
+        # exactly one winner per shard, each with a done record
+        winners = [n for n in lineage["nodes"] if n["winner"]]
+        assert sorted(n["shard"] for n in winners) == [0, 1, 2]
+        for n in winners:
+            assert done.count(n["key"]) == 1, (n["key"], done)
+        # the forced rebalance shows up as lineage, not just a
+        # counter
+        kinds = {e["kind"] for e in lineage["edges"]}
+        assert {"shard", "rebalance", "gather"} <= kinds, kinds
+        # the SIGKILL'd backend is flagged unreachable, loudly
+        dead = [d for d in lineage["daemons"] if not d["ok"]]
+        assert [d["target"] for d in dead] == [a_sock]
+        assert any("unreachable" in w for w in lineage["warnings"])
+        # live daemons got offset estimates with finite confidence
+        for d in lineage["daemons"]:
+            if d["ok"]:
+                assert d["clock_offset_s"] is not None
+                assert d["offset_confidence_s"] is not None
+                assert d["capture"]["flight"]["capacity"] > 0
+
+        # -- clock-skew injection: order invariance ------------------
+        rows0 = [(lane, text) for _, lane, text
+                 in assemble._timeline_rows(collection)]
+        assert rows0
+        skewed = copy.deepcopy(collection)
+        live = [d for d in skewed["daemons"] if d["ok"]]
+        for d, s in zip(live, (5.0, -3.25, 60.0)):
+            for f in ("wall_t", "trace_epoch_wall"):
+                if isinstance(d.get(f), (int, float)):
+                    d[f] += s
+            d["clock_offset_s"] = \
+                (d.get("clock_offset_s") or 0.0) + s
+            for rec in (d.get("journal") or {}).get("records", ()):
+                if isinstance(rec.get("t"), (int, float)):
+                    rec["t"] += s
+        rows1 = [(lane, text) for _, lane, text
+                 in assemble._timeline_rows(skewed)]
+        assert rows1 == rows0, "clock skew reordered the timeline"
+
+        # -- merged Perfetto doc -------------------------------------
+        tdoc = assemble.merged_trace_doc(lineage, collection)
+        json.loads(json.dumps(tdoc))
+        metas = [e for e in tdoc["traceEvents"]
+                 if e.get("ph") == "M"
+                 and e["name"] == "process_name"]
+        assert len(metas) == 4       # router + 3 backends
+        assert any(e.get("ph") == "s"
+                   for e in tdoc["traceEvents"])
+        assert any(e.get("ph") == "f"
+                   for e in tdoc["traceEvents"])
+
+        # -- the CLI surface -----------------------------------------
+        trace_path = os.path.join(serve_tmp, "merged.json")
+        run = subprocess.run(
+            [sys.executable, "-m", "racon_tpu.cli", "inspect",
+             "--fleet", r_sock, "--job-key", key,
+             "--trace-out", trace_path],
+            cwd=REPO_ROOT, capture_output=True,
+            env=_serve_env(serve_tmp), timeout=300)
+        assert run.returncode == 0, (run.stdout, run.stderr)
+        out = run.stdout.decode()
+        assert "complete" in out and "lane" in out
+        assert "edge rebalance" in out
+        with open(trace_path) as fh:
+            assert json.load(fh)["traceEvents"]
+    finally:
+        if proc_a.poll() is None:
+            proc_a.kill()
+        _stop(proc_b, b_sock)
+        _stop(proc_c, c_sock)
+        _stop(proc_r, r_sock)

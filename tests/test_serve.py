@@ -408,7 +408,11 @@ def test_admission_pricing_rejects_monster_jobs(dataset, monkeypatch):
         monkeypatch.delenv("RACON_TPU_SERVE_MAX_WALL_S")
         job = sched.submit(spec)
         job.done.wait(timeout=30)
-        assert job.result == {"ok": True}
+        # since r23 every ok result carries the job's trace id so
+        # fleet forensics can correlate dedup-replayed frames
+        assert job.result["ok"] is True
+        assert job.result["trace_id"] == job.trace_id
+        assert set(job.result) == {"ok", "trace_id"}
     finally:
         sched.drain(timeout=10)
 
