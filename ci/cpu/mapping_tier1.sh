@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Internal overlap discovery lane (ISSUE 20 CI satellite): the
+# minimap-lite mapper (racon_tpu/overlap) is pure data plane — same
+# reads + draft + knobs give byte-identical overlaps and FASTA, with
+# or without a client-supplied PAF anywhere in the fleet.
+#
+#   1. the FULL tier-1 suite with the mapper knobs PINNED explicitly
+#      (k/w/occ/min-chain/band/max-gap at their defaults) so every
+#      byte-identity golden runs under a fully resolved mapper
+#      environment — a knob default drifting out from under the
+#      recorded goldens fails here first.  PYTHONDEVMODE=1 surfaces
+#      unclosed parser/draft fds across the multi-round drivers.
+#   2. a no-PAF 2-round e2e smoke: reads + draft only, two rounds,
+#      run twice; both runs must emit byte-identical FASTA, every
+#      round must bill a nonzero map stage, and round 2 from a
+#      converged draft must re-serve units from the
+#      content-addressed cache (the r24 round synergy).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ci/common/build.sh
+export PYTHONDEVMODE=1
+export RACON_TPU_MAP_K=13
+export RACON_TPU_MAP_W=5
+export RACON_TPU_MAP_OCC=64
+export RACON_TPU_MAP_MIN_CHAIN=4
+export RACON_TPU_MAP_BAND=500
+export RACON_TPU_MAP_MAX_GAP=10000
+python -m pytest tests/ -q -m "not slow" \
+    -o faulthandler_timeout="${FAULTHANDLER_TIMEOUT:-600}"
+
+echo "[mapping_tier1] no-PAF 2-round e2e smoke"
+python - <<'EOF'
+import os
+import tempfile
+
+from racon_tpu.tools import simulate
+from racon_tpu.core.polisher import PolisherType
+from racon_tpu.overlap import polish_rounds
+from racon_tpu.overlap.rounds import write_fasta
+
+
+def rounds2(reads, target):
+    polished, pol = polish_rounds(
+        reads, None, target, PolisherType.kC, 500, 10.0, 0.3,
+        False, 3, -5, -4, num_threads=4, rounds=2)
+    report = pol.rounds_report
+    pol.close()
+    fasta = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                     for s in polished)
+    return fasta, polished, report
+
+
+with tempfile.TemporaryDirectory(prefix="racon_mapsmoke_") as tmp:
+    reads, _paf, draft = simulate.simulate(
+        tmp, genome_len=12_000, coverage=6, read_len=900, seed=5,
+        ont=True)
+    first, polished, rep1 = rounds2(reads, draft)
+    second, _, rep2 = rounds2(reads, draft)
+    assert first == second, "2-round rerun bytes differ"
+    assert all(r["map_s"] > 0 for r in rep1), rep1
+    assert all(r["overlaps"] > 0 for r in rep1), rep1
+    # converged draft: round 2's units are round 1's, all cached
+    fixed = os.path.join(tmp, "fixed.fasta")
+    write_fasta(fixed, polished)
+    _, _, rep3 = rounds2(reads, fixed)
+    assert rep3[1]["cache_hit"] > 0, rep3
+    print(f"[mapping_tier1] smoke ok: "
+          f"{rep1[0]['overlaps']} overlaps/round, bytes identical, "
+          f"{rep3[1]['cache_hit']} round-2 cache hits")
+EOF

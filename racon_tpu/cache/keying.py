@@ -90,6 +90,11 @@ EPOCH_EXCLUDE = frozenset({
     "RACON_TPU_CALIB_DRIFT_EPOCH",
     "RACON_TPU_CLASS_TARGET_P99_S",
     "RACON_TPU_CLASS_HEADROOM",
+    # r24 internal mapping: ONLY the placement/pricing knobs.  The
+    # mapper's k/w/occ/min-chain/band/max-gap knobs change which
+    # overlaps exist (bytes!) and deliberately stay IN the epoch.
+    "RACON_TPU_MAP_DEVICE_SEED",
+    "RACON_TPU_SERVE_MAP_MBPS",
 })
 
 DIGEST_SIZE = 32

@@ -26,9 +26,11 @@ from racon_tpu.io.parsers import (MalformedInputError,
                                   UnsupportedFormatError)
 
 USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequences>
+       racon-tpu [run] [options ...] [--rounds N] <sequences> <target sequences>
        racon-tpu serve --socket PATH [options ...]
        racon-tpu route --socket PATH --backends S1,S2,.. [--tcp HOST:PORT]
        racon-tpu submit --socket PATH [options ...] <sequences> <overlaps> <target sequences>
+       racon-tpu submit --socket PATH [options ...] [--rounds N] <sequences> <target sequences>
        racon-tpu status --socket PATH [--json]
        racon-tpu top (--socket PATH | --fleet S1,S2,..) [--interval S] [--once] [--json]
        racon-tpu metrics (--socket PATH | --fleet S1,S2,..) [--json|--prometheus]
@@ -82,7 +84,10 @@ USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequenc
         containing sequences used for correction
     <overlaps>
         input file in MHAP/PAF/SAM format (can be compressed with gzip)
-        containing overlaps between sequences and target sequences
+        containing overlaps between sequences and target sequences;
+        OMIT this input (two positionals) to discover overlaps with
+        the built-in minimap-lite mapper (racon_tpu/overlap) — no
+        minimap2 required
     <target sequences>
         input file in FASTA/FASTQ format (can be compressed with gzip)
         containing sequences which will be corrected
@@ -134,6 +139,13 @@ USAGE = """usage: racon-tpu [options ...] <sequences> <overlaps> <target sequenc
         --metrics-json <file>
             write the run report (metrics registry + environment
             provenance); RACON_TPU_METRICS_JSON equivalent
+        --rounds <int>
+            default: 1
+            number of polishing rounds: after each round the reads
+            are re-mapped against the polished draft and it is
+            polished again (rounds past the first always use the
+            internal mapper — any supplied overlaps file describes
+            the ORIGINAL draft only)
 """
 
 
@@ -145,6 +157,7 @@ def parse_args(argv):
         "gap": -4, "threads": 1, "type": PolisherType.kC,
         "drop_unpolished": True, "tpu_poa_batches": 0,
         "tpu_banded_alignment": False, "tpu_aligner_batches": 0,
+        "rounds": 1,
         # observability (racon_tpu/obs): env defaults keep library
         # and CLI runs on one switch
         "trace": os.environ.get("RACON_TPU_TRACE") or None,
@@ -206,6 +219,10 @@ def parse_args(argv):
             opts["tpu_aligner_batches"] = int(take_value(a))
         elif a.startswith("--tpualigner-batches="):
             opts["tpu_aligner_batches"] = int(a.split("=", 1)[1])
+        elif a == "--rounds":
+            opts["rounds"] = int(take_value(a))
+        elif a.startswith("--rounds="):
+            opts["rounds"] = int(a.split("=", 1)[1])
         elif a == "--trace":
             opts["trace"] = take_value(a)
         elif a.startswith("--trace="):
@@ -255,6 +272,7 @@ def _log_run_summary(polisher, opts) -> None:
     # answerable from a production run's stderr (CPU-only runs too)
     print("[racon_tpu::] host budget: "
           f"parse {float(m.value('host.parse_s')):.2f} s, "
+          f"map {float(m.value('host.map_s')):.2f} s, "
           f"bp decode {float(m.value('host.bp_decode_s')):.2f} s, "
           f"fragment {float(m.value('host.fragment_s')):.2f} s, "
           f"stitch {float(m.value('host.stitch_s')):.2f} s, "
@@ -293,6 +311,10 @@ def main(argv=None):
     if argv and argv[0] == "explain":
         from racon_tpu.serve import explain as serve_explain
         raise SystemExit(serve_explain.main(argv[1:]))
+    if argv and argv[0] == "run":
+        # explicit alias for the one-shot form (reads -> assembly
+        # without a PAF reads best as `racon-tpu run reads draft`)
+        argv = argv[1:]
     try:
         opts, inputs = parse_args(argv)
     except ValueError as exc:
@@ -300,7 +322,10 @@ def main(argv=None):
               file=sys.stderr)
         raise SystemExit(1)
 
-    if len(inputs) < 3:
+    if len(inputs) == 2:
+        # two positionals = reads + draft: internal overlap discovery
+        inputs = [inputs[0], None, inputs[1]]
+    elif len(inputs) < 3:
         print("[racon_tpu::] error: missing input file(s)!", file=sys.stderr)
         print(USAGE, end="", file=sys.stderr)
         raise SystemExit(1)
@@ -319,8 +344,9 @@ def main(argv=None):
     if flight_dump and obs_flight.enabled():
         obs_flight.FLIGHT.install_dump_on_crash(flight_dump)
     obs_flight.FLIGHT.record(
-        "run", inputs=[os.path.basename(p) for p in inputs[:3]],
-        threads=opts["threads"])
+        "run", inputs=[os.path.basename(p) for p in inputs[:3]
+                       if p is not None],
+        rounds=opts["rounds"], threads=opts["threads"])
 
     if opts["tpu_poa_batches"] > 0 or opts["tpu_aligner_batches"] > 0:
         # kick off the AOT-shelf prewarm NOW, before the (multi-second)
@@ -335,16 +361,18 @@ def main(argv=None):
             pass   # TPU support missing: create_polisher reports it
 
     try:
-        polisher = create_polisher(
-            inputs[0], inputs[1], inputs[2], opts["type"],
-            opts["window_length"], opts["quality_threshold"],
-            opts["error_threshold"], opts["trim"], opts["match"],
-            opts["mismatch"], opts["gap"], opts["threads"],
-            opts["tpu_poa_batches"], opts["tpu_banded_alignment"],
-            opts["tpu_aligner_batches"])
         with obs.span("racon_tpu.run", cat="stage"):
-            polisher.initialize()
-            polished = polisher.polish(opts["drop_unpolished"])
+            from racon_tpu.overlap import rounds as overlap_rounds
+            polished, polisher = overlap_rounds.polish_rounds(
+                inputs[0], inputs[1], inputs[2], opts["type"],
+                opts["window_length"], opts["quality_threshold"],
+                opts["error_threshold"], opts["trim"], opts["match"],
+                opts["mismatch"], opts["gap"], opts["threads"],
+                rounds=opts["rounds"],
+                drop_unpolished=opts["drop_unpolished"],
+                tpu_poa_batches=opts["tpu_poa_batches"],
+                tpu_banded_alignment=opts["tpu_banded_alignment"],
+                tpu_aligner_batches=opts["tpu_aligner_batches"])
         polisher.total_log()
         _log_run_summary(polisher, opts)
     except (InvalidInputError, UnsupportedFormatError,
@@ -372,6 +400,7 @@ def main(argv=None):
         provenance.write_metrics_json(
             opts["metrics_json"], run_registry=polisher.metrics,
             details={
+                "rounds": getattr(polisher, "rounds_report", []),
                 "stage_walls": {
                     k: round(v, 6) for k, v in
                     getattr(polisher, "stage_walls", {}).items()},

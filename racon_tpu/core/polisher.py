@@ -43,7 +43,7 @@ class PolisherType(enum.Enum):
     kF = 1  # fragment (read) error correction
 
 
-def create_polisher(sequences_path: str, overlaps_path: str,
+def create_polisher(sequences_path: str, overlaps_path: Optional[str],
                     target_path: str, type_: PolisherType,
                     window_length: int, quality_threshold: float,
                     error_threshold: float, trim: bool, match: int,
@@ -56,6 +56,12 @@ def create_polisher(sequences_path: str, overlaps_path: str,
     TPU offload is selected per stage by ``tpu_poa_batches`` /
     ``tpu_aligner_batches`` the same way the reference gates CUDA
     offload by --cudapoa-batches / --cudaaligner-batches.
+
+    ``overlaps_path=None`` (r24) selects internal overlap discovery:
+    instead of parsing a PAF/MHAP/SAM file, initialize() maps the
+    reads against the targets with the built-in minimap-lite mapper
+    (racon_tpu/overlap) and feeds the discovered overlaps through the
+    exact same filter/align path.
     """
     if not isinstance(type_, PolisherType):
         raise InvalidInputError("invalid polisher type!")
@@ -63,7 +69,8 @@ def create_polisher(sequences_path: str, overlaps_path: str,
         raise InvalidInputError("invalid window length!")
 
     sparser = create_sequence_parser(sequences_path)
-    oparser = create_overlap_parser(overlaps_path)
+    oparser = (create_overlap_parser(overlaps_path)
+               if overlaps_path is not None else None)
     tparser = create_sequence_parser(target_path)
 
     if tpu_poa_batches > 0 or tpu_aligner_batches > 0:
@@ -79,6 +86,31 @@ def create_polisher(sequences_path: str, overlaps_path: str,
     return Polisher(sparser, oparser, tparser, type_, window_length,
                     quality_threshold, error_threshold, trim, match,
                     mismatch, gap, num_threads)
+
+
+class _MappedOverlapSource:
+    """Parser-shaped view over internally discovered overlaps (r24).
+
+    Lets ``_load_overlaps`` run its existing transmute/filter loop
+    unchanged over in-memory mapper output: one chunk, then done.  No
+    ``set_stage`` on purpose — staged-input plans describe file byte
+    ranges and do not apply to mapped records."""
+
+    def __init__(self, records: List[Overlap]):
+        self._records = records
+        self._done = False
+
+    def reset(self) -> None:
+        self._done = False
+
+    def close(self) -> None:
+        self._records = []
+
+    def parse(self, dst: List[Overlap], max_bytes: int) -> bool:
+        if not self._done:
+            dst.extend(self._records)
+            self._done = True
+        return False
 
 
 class Polisher:
@@ -117,6 +149,13 @@ class Polisher:
         self._first_window_id: List[int] = []
         self._targets_size = 0
         self._coverage_counted = False
+        # r24 internal mapping: oparser None means initialize()
+        # discovers overlaps with racon_tpu/overlap instead of
+        # parsing a file; stats land here for reports/decisions
+        self._map_stats: Optional[dict] = None
+        # per-stage wall clocks surfaced in --metrics-json and the
+        # serve report (the TPU subclass adds its device stages)
+        self.stage_walls: Dict[str, float] = {}
         self.dummy_quality = b"!" * window_length
         # per-run metrics registry (racon_tpu/obs): every counter this
         # run records also propagates into the process-wide REGISTRY,
@@ -258,11 +297,20 @@ class Polisher:
         self.logger.log("[racon_tpu::Polisher::initialize] loaded sequences")
         self.logger.log()
 
+        # parsed overlaps bill the parse budget; internally mapped
+        # ones bill the map stage (host.map_s + stage_walls["map"]),
+        # which is how the stage reaches calhealth drift and the
+        # serve `explain` cost waterfall
+        mapping = self.oparser is None
         with obs_trace.span("racon_tpu.load_overlaps", cat="stage",
-                            metric="host.parse_s",
+                            metric=("host.map_s" if mapping
+                                    else "host.parse_s"),
                             registry=self.metrics):
             overlaps = self._load_overlaps(name_to_id, id_to_id,
                                            has_data, has_reverse_data)
+        if mapping:
+            self.stage_walls["map"] = float(
+                self.metrics.value("host.map_s", 0.0))
         # a multi-host rank may legitimately own zero overlaps (its
         # targets drew none); only single-process runs treat an empty
         # set as invalid input
@@ -330,9 +378,48 @@ class Polisher:
                              - int(plan.get("staged_bytes", 0))))
         return plan
 
+    def _discover_overlaps(self) -> List[Overlap]:
+        """r24 internal mapping: run the minimap-lite mapper over the
+        already-loaded reads/targets and return PAF-shaped Overlap
+        records, ready for the same transmute/filter loop a parsed
+        file takes.  Reads deduplicated into targets are not mapped —
+        their only admissible overlap (self vs self) is exactly what
+        the ``q_id == t_id`` filter drops anyway."""
+        from racon_tpu.obs import decision as obs_decision
+        from racon_tpu.overlap import chain as overlap_chain
+
+        params = overlap_chain.params_from_env()
+        targets = self.sequences[:self._targets_size]
+        queries = self.sequences[self._targets_size:]
+        raw, stats = overlap_chain.map_sequences(queries, targets,
+                                                 params=params)
+        self._map_stats = stats
+        self.metrics.add("map_queries", len(queries))
+        self.metrics.add("map_overlaps", len(raw))
+        self.metrics.add("map_chains_admitted",
+                         stats["chains_admitted"])
+        self.metrics.add("map_chains_rejected",
+                         stats["chains_rejected"])
+        obs_decision.DECISIONS.record(
+            "map_chain", queries=len(queries),
+            targets=len(targets), overlaps=len(raw),
+            admitted=stats["chains_admitted"],
+            rejected=stats["chains_rejected"],
+            masked_entries=stats["masked_entries"],
+            knobs=params.doc())
+        self.logger.log(
+            f"[racon_tpu::Polisher::initialize] mapped {len(queries)} "
+            f"reads -> {len(raw)} overlaps "
+            f"({stats['chains_rejected']} chains rejected)")
+        return raw
+
     def _load_overlaps(self, name_to_id, id_to_id, has_data,
                        has_reverse_data) -> List[Overlap]:
         """Stream overlaps, transmute, and filter (polisher.cpp:283-354)."""
+        if self.oparser is None:
+            # internal mapping: same downstream loop, fed from an
+            # in-memory single-chunk source instead of a file parser
+            self.oparser = _MappedOverlapSource(self._discover_overlaps())
         self._configure_stage()
         overlaps: List[Optional[Overlap]] = []
 
@@ -670,14 +757,16 @@ class Polisher:
         -plane seconds (CPU-seconds — concurrent stages can sum past
         the wall) and the share of the run wall they represent."""
         host_s = sum(float(self.metrics.value(k, 0.0))
-                     for k in ("host.parse_s", "host.bp_decode_s",
-                               "host.fragment_s", "host.stitch_s"))
+                     for k in ("host.parse_s", "host.map_s",
+                               "host.bp_decode_s", "host.fragment_s",
+                               "host.stitch_s"))
         self.metrics.set("host.stage_s", round(host_s, 6))
         # calibration health (r16): host stages have no calibrate
         # rate, so drift is measured against the stage's own learned
         # per-unit rate (racon_tpu/obs/calhealth.observe_units) —
         # unit counts are the natural stage denominators
         units = {"host.parse": len(self.sequences),
+                 "host.map": int(self.metrics.value("map_queries", 0)),
                  "host.bp_decode": len(self.sequences),
                  "host.fragment": len(self.windows),
                  "host.stitch": self._targets_size}

@@ -577,3 +577,21 @@ class SamScanParser(_OverlapScanParser):
                 *_sam_run_fields(*runs[r]))
             o.cigar_runs = runs[r]
             dst.append(o)
+
+
+def drain(parser, chunk_bytes: int = 1 << 26) -> list:
+    """Stream every record out of a parser into a fresh list.
+
+    The r24 internal mapper consumes whole files (reads, draft) rather
+    than polisher-style incremental chunks; this keeps that loop in one
+    place.  Works with any parser exposing the bioparser ``parse(dst,
+    max_bytes) -> more`` protocol, closes the parser when drained."""
+    records: list = []
+    try:
+        while parser.parse(records, chunk_bytes):
+            pass
+    finally:
+        close = getattr(parser, "close", None)
+        if close is not None:
+            close()
+    return records

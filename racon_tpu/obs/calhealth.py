@@ -50,8 +50,8 @@ from racon_tpu.obs.metrics import REGISTRY, hist_quantile
 
 #: calibration stages tracked (order is the render order)
 STAGES = ("align_wfa", "align_band", "poa",
-          "host.parse", "host.bp_decode", "host.fragment",
-          "host.stitch")
+          "host.parse", "host.map", "host.bp_decode",
+          "host.fragment", "host.stitch")
 
 #: advisory healthy band for the EWMA ratio (actual/predicted)
 DRIFT_BAND = (0.5, 2.0)

@@ -27,6 +27,9 @@ envelope; see the call sites):
   measured wall
 * ``poa_reject``    — per-window engine reject codes
 * ``shelf``         — AOT-shelf variant hit/miss/fallback
+* ``map_chain``     — internal overlap discovery (r24): anchors
+  chained per job — queries/targets/overlaps emitted, chains
+  admitted vs rejected, and the mapper knobs they were scored with
 * ``job_stages``    — per-job stage-wall rollup (serve sessions)
 * ``unit_retry``    — executor poisoned-unit fallback (also mirrored
   into the flight ring for ``inspect`` timelines)

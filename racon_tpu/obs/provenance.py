@@ -149,6 +149,21 @@ KNOWN_KNOBS = {
     "RACON_TPU_CALIB_DRIFT_EPOCH": "0",
     "RACON_TPU_CLASS_TARGET_P99_S": "2.0",
     "RACON_TPU_CLASS_HEADROOM": "0.125",
+    # r24 internal overlap discovery (racon_tpu/overlap): the mapper
+    # knobs select which overlaps exist, so they CHANGE BYTES — none
+    # of k/w/occ/min-chain/band/max-gap may be EPOCH_EXCLUDEd; they
+    # fold into the cache engine epoch like match/mismatch/gap do.
+    "RACON_TPU_MAP_K": "13",
+    "RACON_TPU_MAP_W": "5",
+    "RACON_TPU_MAP_OCC": "64",
+    "RACON_TPU_MAP_MIN_CHAIN": "4",
+    "RACON_TPU_MAP_BAND": "500",
+    "RACON_TPU_MAP_MAX_GAP": "10000",
+    # ...whereas these two are placement/pricing only: device seeding
+    # is pinned bit-identical to the host build, and the map
+    # throughput prior feeds admission estimates — both excluded.
+    "RACON_TPU_MAP_DEVICE_SEED": "0",
+    "RACON_TPU_SERVE_MAP_MBPS": "8.0",
 }
 
 # host-capability probe reference wall (bench.py's budget scaling):

@@ -1,0 +1,45 @@
+"""Internal overlap discovery (r24): a minimap-lite read->draft mapper.
+
+The reference pipeline never runs racon alone — real assemblies run
+minimap2 to discover read->draft overlaps and polish 2-4 rounds.  This
+package closes that gap in-process:
+
+- :mod:`minimizers` — host-vectorized k-mer minimizer extraction
+  (numpy rolling 2-bit pack + invertible 32-bit mix + windowed argmin,
+  no per-base Python),
+- :mod:`index`      — target-side minimizer hash index with
+  occurrence-cap masking of repeats,
+- :mod:`chain`      — anchor collinear chaining (sorted-diagonal
+  banding + LIS-style DP) emitting PAF-shaped
+  :class:`~racon_tpu.core.overlap.Overlap` records that feed the
+  existing breaking-point re-align path exactly like an external PAF,
+- :mod:`rounds`     — the multi-round driver: polish -> re-map reads
+  against the polished draft -> re-polish, N rounds.
+
+Determinism contract: mapping is pure data plane.  Same inputs =>
+byte-identical overlaps => byte-identical FASTA.  The mapper knobs
+(RACON_TPU_MAP_K/W/OCC/MIN_CHAIN/BAND/MAX_GAP) change bytes, so they
+are registered in provenance KNOWN_KNOBS and fold into the cache
+engine epoch (NOT EPOCH_EXCLUDEd).  RACON_TPU_MAP_DEVICE_SEED only
+moves the seeding arithmetic between host and device with bit-equal
+results, so it is epoch-excluded like every placement knob.
+"""
+
+from racon_tpu.overlap.chain import MapParams, map_sequences, params_from_env
+from racon_tpu.overlap.rounds import polish_rounds
+
+__all__ = ["MapParams", "map_sequences", "params_from_env",
+           "polish_rounds", "map_files"]
+
+
+def map_files(sequences_path: str, target_path: str, params=None):
+    """Map reads from ``sequences_path`` against ``target_path`` and
+    return (overlaps, stats).  Standalone convenience over the same
+    code path the polisher uses — fastio scan parsers stream both
+    files, then :func:`chain.map_sequences` does the work."""
+    from racon_tpu.io import fastio
+    from racon_tpu.io.parsers import create_sequence_parser
+
+    reads = fastio.drain(create_sequence_parser(sequences_path))
+    targets = fastio.drain(create_sequence_parser(target_path))
+    return map_sequences(reads, targets, params=params)

@@ -331,9 +331,18 @@ def spec_from_opts(opts: dict, inputs, tenant: str = None,
     spec = {} if tenant is None else {"tenant": tenant}
     if job_class is not None:
         spec["class"] = job_class
+    # r24: two inputs (reads, draft) select internal overlap
+    # discovery — overlaps=None plus a rounds count is the submit
+    # spec's opt-in the scheduler admission checks for
+    if len(inputs) == 2:
+        inputs = [inputs[0], None, inputs[1]]
+    rounds = int(opts.get("rounds", 1) or 1)
+    if inputs[1] is None or rounds > 1:
+        spec["rounds"] = max(1, rounds)
     spec.update({
         "sequences": os.path.abspath(inputs[0]),
-        "overlaps": os.path.abspath(inputs[1]),
+        "overlaps": (os.path.abspath(inputs[1])
+                     if inputs[1] is not None else None),
         "targets": os.path.abspath(inputs[2]),
         "type": opts["type"].name,
         "window_length": opts["window_length"],
@@ -426,7 +435,7 @@ def main_submit(argv) -> int:
               "'interactive' or 'batch'!", file=sys.stderr)
         return 1
     opts, inputs = cli.parse_args(rest)
-    if len(inputs) < 3:
+    if len(inputs) < 2:
         print("[racon_tpu::submit] error: missing input file(s)!",
               file=sys.stderr)
         return 1

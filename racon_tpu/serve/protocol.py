@@ -303,6 +303,30 @@ Fleet forensics (r23, racon_tpu/obs/assemble.py +
   ``router_flight_events`` / ``router_trace_events`` beside the
   winning backend's — forensic parity between the two halves of a
   routed job.
+
+Internal overlap discovery + rounds (r24, racon_tpu/overlap/):
+
+* ``submit`` specs no longer require an overlaps input.
+  ``overlaps: null`` (or the key absent) plus an integer ``rounds``
+  field (1..16; out-of-range or non-integer is ``bad_request``)
+  opts the job into the in-process minimap-lite mapper: overlaps
+  are discovered against the draft before polishing, and the job
+  runs ``rounds`` polish→re-map→re-polish rounds.  The client
+  builds this spec from ``submit reads.fq draft.fa --rounds N``
+  (two positionals, no PAF).
+* A spec with no overlaps and NO ``rounds`` field is answered with
+  the structured ``missing_overlaps`` error code (machine-readable,
+  distinct from ``input_not_found``) whose ``hint`` names the
+  ``--rounds`` opt-in and the accepted external formats.
+* The admission estimate prices the map stage from input bytes
+  (``RACON_TPU_SERVE_MAP_MBPS``) — surfaced as ``map_s`` in the
+  ``estimate`` block — and multiplies the wall terms by the round
+  count (``rounds`` echoed in the estimate).
+* The per-job report's ``details`` carry a ``rounds`` list (one
+  entry per round: ``wall_s``, ``map_s``, ``overlaps``,
+  ``cache_hit``, ``n_sequences``) so clients can observe the
+  inter-round cache discount; scatter sub-jobs inherit the whole
+  spec, so ``rounds`` rides shard plans unchanged.
 """
 
 from __future__ import annotations

@@ -788,6 +788,12 @@ class FleetRouter:
         from racon_tpu.io import staging
         if not staging.stage_enabled():
             return {}
+        # r24: an internal-mapping spec has no overlaps file to
+        # slice — each shard maps its reads itself; the rounds field
+        # rides the shard specs (scatter.shard_spec copies the whole
+        # spec), which IS the per-shard round plan
+        if spec.get("overlaps") is None:
+            return {}
         try:
             names = staging.fasta_names(spec["targets"])
             index = staging.get_index(spec["overlaps"], names)

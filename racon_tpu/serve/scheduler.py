@@ -69,6 +69,9 @@ def _env_int(name: str, default: int) -> int:
 # submission alone.  RACON_TPU_SERVE_{ALIGN,POA}_MBPS override.
 _ALIGN_MB_PER_S = 4.0
 _POA_MB_PER_S = 2.0
+# r24 internal mapping prior: minimizer extraction + chaining over
+# reads+draft bytes (RACON_TPU_SERVE_MAP_MBPS overrides)
+_MAP_MB_PER_S = 8.0
 
 
 def _mean_fusion_occupancy() -> float:
@@ -175,7 +178,10 @@ def estimate_job(spec: dict, concurrency: int = 1,
 
     sizes = {}
     for key in ("sequences", "overlaps", "targets"):
-        sizes[key] = os.stat(spec[key]).st_size
+        path = spec.get(key)
+        # r24: overlaps may be absent (internal mapping); the map
+        # stage is priced separately below
+        sizes[key] = os.stat(path).st_size if path is not None else 0
     align_mbps = float(os.environ.get("RACON_TPU_SERVE_ALIGN_MBPS",
                                       _ALIGN_MB_PER_S))
     poa_mbps = float(os.environ.get("RACON_TPU_SERVE_POA_MBPS",
@@ -202,6 +208,19 @@ def estimate_job(spec: dict, concurrency: int = 1,
     # read volume layered over the targets
     align_s = (sizes["sequences"] + overlap_bytes) / mb / align_mbps
     poa_s = (sizes["sequences"] + sizes["targets"]) / mb / poa_mbps
+    # r24 internal mapping: a no-overlaps spec runs the minimap-lite
+    # map stage over reads+targets before aligning; priced from its
+    # own throughput prior.  A stale externally-supplied PAF never
+    # reaches rounds > 1 either — every round past the first re-maps,
+    # so the whole pipeline repeats per round.
+    map_s = 0.0
+    rounds = spec.get("rounds")
+    rounds = rounds if isinstance(rounds, int) and rounds >= 1 else 1
+    if spec.get("overlaps") is None:
+        map_mbps = float(os.environ.get("RACON_TPU_SERVE_MAP_MBPS",
+                                        _MAP_MB_PER_S))
+        map_s = (sizes["sequences"] + sizes["targets"]) / mb / map_mbps
+        align_s += map_s
     if hit_ratio is None:
         hit_ratio = _observed_hit_ratio()
     est = calibrate.predict_walls(align_s, poa_s,
@@ -209,6 +228,16 @@ def estimate_job(spec: dict, concurrency: int = 1,
                                   concurrency=concurrency,
                                   occupancy=_mean_fusion_occupancy(),
                                   hit_ratio=hit_ratio)
+    if rounds > 1:
+        # later rounds re-map + re-polish; cache reuse of unchanged
+        # windows is already folded in through hit_ratio
+        for field in ("additive_wall_s", "overlap_floor_s",
+                      "predicted_wall_s", "shared_wall_s"):
+            if isinstance(est.get(field), (int, float)):
+                est[field] = round(est[field] * rounds, 6)
+        est["rounds"] = rounds
+    if map_s > 0.0:
+        est["map_s"] = round(map_s, 6)
     est["input_bytes"] = sizes
     if staged_fraction is not None:
         est["staged_fraction"] = round(staged_fraction, 6)
@@ -419,6 +448,22 @@ class JobScheduler:
                 return hit
         for key in ("sequences", "overlaps", "targets"):
             path = spec.get(key)
+            if key == "overlaps" and path is None:
+                # r24: overlaps are optional WHEN the spec opts into
+                # internal mapping by carrying a rounds count.  A
+                # bare no-overlaps spec gets a structured reject
+                # (not the generic input_not_found) telling the
+                # client exactly how to opt in.
+                if spec.get("rounds") is not None:
+                    continue
+                raise RejectError({
+                    "code": "missing_overlaps",
+                    "reason": "spec has no overlaps input and does "
+                              "not request internal mapping",
+                    "hint": "resubmit with --rounds N (spec field "
+                            "\"rounds\") to map reads against the "
+                            "draft with the built-in mapper, or "
+                            "supply a PAF/MHAP/SAM overlaps path"})
             if not isinstance(path, str):
                 raise RejectError({"code": "bad_request",
                                    "reason": f"missing input '{key}'"})
@@ -427,6 +472,13 @@ class JobScheduler:
                     "code": "input_not_found",
                     "reason": f"{key} file not found on the server "
                               f"host: {path}"})
+        rounds = spec.get("rounds")
+        if rounds is not None and (not isinstance(rounds, int)
+                                   or isinstance(rounds, bool)
+                                   or not 1 <= rounds <= 16):
+            raise RejectError({
+                "code": "bad_request",
+                "reason": "rounds must be an integer in [1, 16]"})
         tenant = spec.get("tenant", "default")
         if not isinstance(tenant, str) or not tenant \
                 or len(tenant) > 64:

@@ -133,8 +133,7 @@ def _wire_durability(polisher, job) -> None:
 
 def run_job(job) -> dict:
     """Execute one admitted job; returns the response frame body."""
-    from racon_tpu.core.polisher import (JobCanceledError,
-                                         PolisherType, create_polisher)
+    from racon_tpu.core.polisher import JobCanceledError, PolisherType
     from racon_tpu.obs import provenance
 
     spec = job.spec
@@ -146,38 +145,63 @@ def run_job(job) -> dict:
         with obs.span("serve.job", cat="serve",
                       args={"job": job.id,
                             "priority": job.priority}):
-            polisher = create_polisher(
-                spec["sequences"], spec["overlaps"], spec["targets"],
-                PolisherType[opts["type"]], opts["window_length"],
-                opts["quality_threshold"], opts["error_threshold"],
-                opts["trim"], opts["match"], opts["mismatch"],
-                opts["gap"], opts["threads"],
-                opts["tpu_poa_batches"], opts["tpu_banded_alignment"],
-                opts["tpu_aligner_batches"])
-            # tag the polisher's device submissions with the job's
-            # tenant so the process-wide executor can fuse them with
-            # other tenants' batches and enforce per-tenant fairness
-            polisher._executor_tenant = getattr(job, "tenant",
-                                                "default")
+            # r24: a spec may omit overlaps (internal mapping) and
+            # carry a rounds count; both run through the multi-round
+            # driver — rounds == 1 with an overlaps file is exactly
+            # the classic single-round pipeline
+            rounds = spec.get("rounds")
+            rounds = (rounds if isinstance(rounds, int)
+                      and not isinstance(rounds, bool)
+                      and rounds >= 1 else 1)
             shard = _shard_of(spec)
-            if shard is not None:
-                polisher._target_shard = shard
-                # r21 staged inputs: the router's plan-time slice
-                # index rides the sub-job spec; the polisher
-                # validates it (path + file signature + shard) and
-                # self-builds or full-parses on any mismatch
-                if isinstance(spec.get("stage"), dict):
-                    polisher._stage_hint = spec["stage"]
-            # r21 rebalancing: the scheduler's cancel flag (set by
-            # the router's `cancel` op when a replacement attempt
-            # superseded this shard) is polled between committed
-            # units — cancel-after-checkpoint by construction
-            cancel = getattr(job, "cancel_requested", None)
-            if cancel is not None:
-                polisher._cancel_check = cancel.is_set
-            _wire_durability(polisher, job)
-            polisher.initialize()
-            polished = polisher.polish(opts["drop_unpolished"])
+
+            def _configure(p):
+                # seam wiring, applied to EVERY round's polisher
+                nonlocal polisher
+                polisher = p
+                # tag the polisher's device submissions with the
+                # job's tenant so the process-wide executor can fuse
+                # them with other tenants' batches and enforce
+                # per-tenant fairness
+                p._executor_tenant = getattr(job, "tenant", "default")
+                if shard is not None:
+                    p._target_shard = shard
+                    # r21 staged inputs: the router's plan-time slice
+                    # index rides the sub-job spec; the polisher
+                    # validates it (path + file signature + shard)
+                    # and self-builds or full-parses on any mismatch.
+                    # Only meaningful with a parsed overlaps file.
+                    if isinstance(spec.get("stage"), dict) \
+                            and spec.get("overlaps") is not None:
+                        p._stage_hint = spec["stage"]
+                # r21 rebalancing: the scheduler's cancel flag (set
+                # by the router's `cancel` op when a replacement
+                # attempt superseded this shard) is polled between
+                # committed units — cancel-after-checkpoint by
+                # construction
+                cancel = getattr(job, "cancel_requested", None)
+                if cancel is not None:
+                    p._cancel_check = cancel.is_set
+                # r17 checkpoints key windows by id within ONE
+                # pipeline pass; multi-round jobs would collide ids
+                # across rounds, so durability wires single-round
+                # jobs only
+                if rounds == 1:
+                    _wire_durability(p, job)
+
+            from racon_tpu.overlap import rounds as overlap_rounds
+            polished, polisher = overlap_rounds.polish_rounds(
+                spec["sequences"], spec.get("overlaps"),
+                spec["targets"], PolisherType[opts["type"]],
+                opts["window_length"], opts["quality_threshold"],
+                opts["error_threshold"], opts["trim"], opts["match"],
+                opts["mismatch"], opts["gap"], opts["threads"],
+                rounds=rounds,
+                drop_unpolished=opts["drop_unpolished"],
+                tpu_poa_batches=opts["tpu_poa_batches"],
+                tpu_banded_alignment=opts["tpu_banded_alignment"],
+                tpu_aligner_batches=opts["tpu_aligner_batches"],
+                configure=_configure)
         fasta = b"".join(b">" + s.name.encode() + b"\n" + s.data
                          + b"\n" for s in polished)
     except JobCanceledError:
@@ -241,6 +265,7 @@ def run_job(job) -> dict:
             "poa_split_detail": getattr(polisher, "poa_split_detail",
                                         {}),
             "shard": list(shard) if shard is not None else None,
+            "rounds": getattr(polisher, "rounds_report", []),
         },
         probe=False)
     polisher.close()

@@ -14,6 +14,7 @@ reproducible run-to-run.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import Tuple
@@ -115,9 +116,10 @@ def _enrich_homopolymers(genome: np.ndarray,
 def simulate(out_dir: str, genome_len: int = 1_000_000,
              coverage: int = 30, read_len: int = 10_000,
              read_error: float = 0.10, draft_error: float = 0.02,
-             seed: int = 7, ont: bool = False) -> Tuple[str, str, str]:
+             seed: int = 7, ont: bool = False,
+             draft_region=None) -> Tuple[str, str, str]:
     """Write genome.fasta (truth), draft.fasta (mutated target),
-    reads.fastq and reads2draft.paf into ``out_dir``.
+    reads.fastq, reads2draft.paf and truth.json into ``out_dir``.
 
     ``ont=True`` selects the ONT-realistic model (the reference
     validates on real E. coli ONT data, ci/gpu/cuda_test.sh:25-33,
@@ -125,6 +127,19 @@ def simulate(out_dir: str, genome_len: int = 1_000_000,
     indels, lognormal read lengths and error-correlated qualities.
     The default stays the legacy uniform mix so recorded baselines
     remain comparable.
+
+    ``draft_region=(begin, end)`` confines draft mutations to that
+    genome-coordinate slice; the rest of the draft is a verbatim copy
+    of the truth.  Localized errors keep most polishing windows
+    byte-stable across rounds, which is the r24 multi-round
+    cache-reuse scenario (round 2 re-polishes a draft that changed
+    only where round 1 actually edited).
+
+    ``truth.json`` records every read's true placement on the DRAFT
+    ({name, length, strand, t_begin, t_end} plus draft_len) so the
+    r24 internal mapper can be scored for recall/precision from reads
+    + draft alone — no minimap2, no PAF consumed (the PAF stays the
+    legacy golden-seed input for PAF-driven runs).
 
     Returns (reads_path, paf_path, draft_path) ready for the polisher;
     genome.fasta is the accuracy oracle.
@@ -135,7 +150,15 @@ def simulate(out_dir: str, genome_len: int = 1_000_000,
     if ont:
         genome = _enrich_homopolymers(genome, rng)
         genome_len = genome.size
-    draft = _mutate(genome, draft_error, rng)
+    if draft_region is None:
+        draft = _mutate(genome, draft_error, rng)
+    else:
+        rb, re_ = (max(0, int(draft_region[0])),
+                   min(genome_len, int(draft_region[1])))
+        draft = np.concatenate((genome[:rb],
+                                _mutate(genome[rb:re_], draft_error,
+                                        rng),
+                                genome[re_:]))
 
     genome_path = os.path.join(out_dir, "genome.fasta")
     with open(genome_path, "wb") as fh:
@@ -154,6 +177,7 @@ def simulate(out_dir: str, genome_len: int = 1_000_000,
     # not exact truth
     dlen = draft.size
     scale = dlen / genome_len
+    truth = []
     with open(reads_path, "wb") as rf, open(paf_path, "wb") as pf:
         for i in range(n_reads):
             if ont:
@@ -203,6 +227,12 @@ def simulate(out_dir: str, genome_len: int = 1_000_000,
                 strand, b"draft", b"%d" % dlen, b"%d" % t_begin,
                 b"%d" % t_end, b"%d" % (t_end - t_begin),
                 b"%d" % (t_end - t_begin), b"255"]) + b"\n")
+            truth.append({"name": name.decode(),
+                          "length": int(data.size),
+                          "strand": strand.decode(),
+                          "t_begin": t_begin, "t_end": t_end})
+    with open(os.path.join(out_dir, "truth.json"), "w") as tf:
+        json.dump({"draft_len": dlen, "reads": truth}, tf, indent=0)
     return reads_path, paf_path, draft_path
 
 

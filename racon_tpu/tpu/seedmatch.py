@@ -1,0 +1,67 @@
+"""Device pre-pass for minimizer seeding (r24 internal mapper).
+
+Builds the mapper's 2-bit packed forward / reverse-complement k-mer
+match words on the accelerator with the same uint32 bit-twiddling the
+WFA kernel uses for its packed wavefront lanes (align_pallas): a
+k-pass shift/OR over the base codes, entirely in 32-bit integer ops so
+the result is bit-identical to the numpy host path in
+racon_tpu.overlap.minimizers — no x64, no floats, no nondeterminism.
+
+This is a pure placement optimization: RACON_TPU_MAP_DEVICE_SEED moves
+the word build between host and device, never changes the words, and
+is therefore EPOCH_EXCLUDEd (the equality is pinned by
+tests/test_overlap_discovery.py).  Sequences are padded up to a bucket
+length so jit retraces stay bounded across read-length diversity.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+#: pad sequences to multiples of this many bases before dispatch, so
+#: the jitted word builder compiles once per bucket, not per read
+BUCKET = 8192
+
+
+@functools.lru_cache(maxsize=None)
+def _builder(k: int):
+    import jax
+    import jax.numpy as jnp
+
+    def build(codes):
+        c = codes.astype(jnp.uint32) & jnp.uint32(3)
+        cc = jnp.uint32(3) - c
+        nk = codes.shape[0] - k + 1
+        fw = jnp.zeros((nk,), dtype=jnp.uint32)
+        rv = jnp.zeros((nk,), dtype=jnp.uint32)
+        for j in range(k):
+            fw = fw | (c[j:j + nk] << jnp.uint32(2 * (k - 1 - j)))
+            rv = rv | (cc[j:j + nk] << jnp.uint32(2 * j))
+        return fw, rv
+
+    return jax.jit(build)
+
+
+def kmer_words_device(codes: np.ndarray, k: int
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Device twin of minimizers.kmer_words: returns (fw, rv) uint32
+    arrays of length len(codes)-k+1, bit-equal to the host build.
+
+    Pads with invalid-base code 4 (masked to 'A' by the &3, exactly as
+    on host; the padded tail words are sliced off before return) so the
+    jit cache is keyed by bucket count, not exact length."""
+    nk = codes.size - k + 1
+    if nk <= 0:
+        z = np.empty(0, dtype=np.uint32)
+        return z, z
+    padded = -(-codes.size // BUCKET) * BUCKET
+    if padded != codes.size:
+        buf = np.full(padded, 4, dtype=np.uint8)
+        buf[:codes.size] = codes
+        codes = buf
+    fw, rv = _builder(int(k))(codes)
+    return (np.asarray(fw)[:nk].astype(np.uint32, copy=False),
+            np.asarray(rv)[:nk].astype(np.uint32, copy=False))

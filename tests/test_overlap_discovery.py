@@ -1,0 +1,558 @@
+"""Internal overlap discovery + multi-round polishing (r24) — ISSUE 20.
+
+The racon_tpu/overlap subsystem replaces the external minimap2 step:
+minimizer sketching (numpy rolling-hash + windowed argmin), a
+target-side minimizer index with occurrence-cap repeat masking, and
+sorted-diagonal + LIS chaining that emits PAF-shaped Overlap records
+into the existing breaking-point re-align path.  Pinned here:
+
+* unit behavior — minimizer determinism and strand canonicalization,
+  host/device k-mer word parity, index occurrence-cap masking, chain
+  coordinates and strand on planted reads;
+* mapping quality — recall >= 0.95 against the simulator's
+  ground-truth placements (reads + draft only, no PAF consumed), and
+  mapper-driven polish within 2% edit distance of the golden-PAF run;
+* rounds — 2-round polishing is byte-deterministic (run twice =>
+  identical FASTA), and round 2 on a converged draft re-serves its
+  units from the content-addressed cache (nonzero ``cache_hit``);
+* serving — a spec with no overlaps and no ``rounds`` gets the
+  structured ``missing_overlaps`` reject naming ``--rounds``, and
+  ``submit --rounds 2`` (no PAF) returns byte-identical FASTA to the
+  standalone CLI.
+"""
+
+import base64
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from racon_tpu.overlap import (MapParams, map_files,  # noqa: E402
+                               map_sequences, params_from_env,
+                               polish_rounds)
+from racon_tpu.overlap import minimizers  # noqa: E402
+from racon_tpu.overlap.index import MinimizerIndex  # noqa: E402
+from racon_tpu.overlap.rounds import write_fasta  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ACGT = np.frombuffer(b"ACGT", dtype=np.uint8)
+
+
+def _random_seq(n, seed):
+    rng = np.random.default_rng(seed)
+    return _ACGT[rng.integers(0, 4, n)].tobytes()
+
+
+def _revcomp(data: bytes) -> bytes:
+    from racon_tpu.core.sequence import _COMPLEMENT
+
+    return data.translate(_COMPLEMENT)[::-1]
+
+
+class _Seq:
+    def __init__(self, name, data):
+        self.name = name
+        self.data = data
+
+
+# ---------------------------------------------------------------------------
+# minimizer units
+# ---------------------------------------------------------------------------
+
+def test_minimizers_deterministic_and_sorted():
+    data = _random_seq(5_000, 1)
+    a = minimizers.extract(data, 13, 5)
+    b = minimizers.extract(data, 13, 5)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    pos, hashes, strands = a
+    assert pos.size > 0
+    assert np.all(np.diff(pos) > 0)          # strictly increasing
+    # density sanity: one minimizer per window of w on average-ish
+    assert pos.size >= len(data) // (5 * 4)
+    assert hashes.dtype == np.uint32 and strands.dtype == np.uint8
+
+
+def test_minimizers_canonical_under_revcomp():
+    """Canonical (min of fw/rc) hashing: a sequence and its reverse
+    complement sketch the same hash multiset with flipped strands."""
+    data = _random_seq(2_000, 2)
+    _, h_fwd, s_fwd = minimizers.extract(data, 13, 5)
+    _, h_rev, s_rev = minimizers.extract(_revcomp(data), 13, 5)
+    assert sorted(h_fwd.tolist()) == sorted(h_rev.tolist())
+    # matching hashes carry opposite strand flags
+    fwd = dict(zip(h_fwd.tolist(), s_fwd.tolist()))
+    rev = dict(zip(h_rev.tolist(), s_rev.tolist()))
+    flipped = sum(1 for k in fwd if k in rev and fwd[k] != rev[k])
+    assert flipped / max(1, len(fwd)) > 0.95
+
+
+def test_minimizers_mask_invalid_bases():
+    data = b"ACGT" * 30 + b"NNNNN" + b"TTAC" * 30
+    pos, hashes, _ = minimizers.extract(data, 13, 5)
+    # no k-mer window may span the N run
+    n0 = data.index(b"N")
+    bad = (pos > n0 - 13) & (pos < n0 + 5)
+    assert not bad.any()
+    assert not (hashes == minimizers.SENTINEL).any()
+
+
+def test_kmer_words_host_device_parity():
+    """The optional device pre-pass must be bit-identical to the host
+    rolling build (uint32-only arithmetic on both sides)."""
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from racon_tpu.tpu import seedmatch
+
+    codes = minimizers.encode(_random_seq(3_000, 3))
+    for k in (5, 13, 15):
+        host = minimizers.kmer_words(codes, k)
+        dev = seedmatch.kmer_words_device(codes, k)
+        np.testing.assert_array_equal(host[0], np.asarray(dev[0]))
+        np.testing.assert_array_equal(host[1], np.asarray(dev[1]))
+
+
+# ---------------------------------------------------------------------------
+# index units
+# ---------------------------------------------------------------------------
+
+def test_index_occurrence_cap_masks_repeats():
+    unique = _random_seq(4_000, 4)
+    repeat = _random_seq(200, 5)
+    data = repeat * 40 + unique
+    idx_capped = MinimizerIndex.build(
+        [_Seq("t", data)], k=13, w=5, occ_cap=4)
+    idx_open = MinimizerIndex.build(
+        [_Seq("t", data)], k=13, w=5, occ_cap=10_000)
+    assert idx_capped.masked_hashes > 0
+    assert idx_capped.masked_entries > 0
+    assert idx_capped.hashes.size < idx_open.hashes.size
+    # capped index still holds the unique tail's minimizers
+    _, h_uniq, _ = minimizers.extract(unique, 13, 5)
+    left, right = idx_capped.lookup(h_uniq)
+    assert ((right - left) > 0).mean() > 0.9
+
+
+def test_index_lookup_exact_positions():
+    data = _random_seq(3_000, 6)
+    idx = MinimizerIndex.build([_Seq("t", data)], k=13, w=5,
+                               occ_cap=64)
+    pos, hashes, _ = minimizers.extract(data, 13, 5)
+    left, right = idx.lookup(hashes)
+    # every queried hash is present, and one of its entries is the
+    # exact source position (invertible hash => no collisions)
+    assert (right > left).all()
+    for i in range(0, pos.size, max(1, pos.size // 50)):
+        entries = idx.tpos[left[i]:right[i]]
+        assert pos[i] in entries
+
+
+# ---------------------------------------------------------------------------
+# chain units
+# ---------------------------------------------------------------------------
+
+def test_chain_planted_reads_coordinates_and_strand():
+    target = _random_seq(20_000, 7)
+    reads = []
+    truth = []
+    rng = np.random.default_rng(8)
+    for i in range(20):
+        b = int(rng.integers(0, 18_000))
+        e = b + int(rng.integers(800, 2_000))
+        piece = target[b:e]
+        strand = bool(rng.integers(0, 2))
+        reads.append(_Seq(f"r{i}",
+                          _revcomp(piece) if strand else piece))
+        truth.append((b, e, strand))
+    overlaps, stats = map_sequences(reads, [_Seq("draft", target)])
+    assert stats["queries"] == 20
+    by_name = {}
+    for o in overlaps:
+        by_name.setdefault(o.q_name, []).append(o)
+    for i, (b, e, strand) in enumerate(truth):
+        ovls = by_name.get(f"r{i}")
+        assert ovls, f"planted read r{i} not mapped"
+        o = ovls[0]
+        assert o.strand == strand
+        assert o.t_name == "draft"
+        # exact substrings: coordinates must be near-exact (the end
+        # extension clamps at target bounds)
+        assert abs(o.t_begin - b) <= 25
+        assert abs(o.t_end - e) <= 25
+
+
+def test_chain_rejects_random_queries():
+    target = _random_seq(20_000, 9)
+    noise = [_Seq("junk", _random_seq(1_500, 10))]
+    overlaps, stats = map_sequences(noise, [_Seq("draft", target)])
+    assert overlaps == []
+    assert stats["overlaps"] == 0
+
+
+def test_map_params_env_roundtrip(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_MAP_K", "11")
+    monkeypatch.setenv("RACON_TPU_MAP_W", "8")
+    monkeypatch.setenv("RACON_TPU_MAP_OCC", "32")
+    monkeypatch.setenv("RACON_TPU_MAP_MIN_CHAIN", "6")
+    p = params_from_env()
+    assert (p.k, p.w, p.occ_cap, p.min_chain) == (11, 8, 32, 6)
+    d = MapParams().doc()
+    assert d["k"] == 13 and d["w"] == 5
+
+
+def test_mapper_knobs_fold_into_cache_epoch(monkeypatch):
+    """k/w/... change which overlaps exist (bytes!), so they must be
+    part of the engine epoch; the placement/pricing knobs must not."""
+    from racon_tpu.cache import keying
+
+    base = keying.engine_epoch()
+    monkeypatch.setenv("RACON_TPU_MAP_K", "9")
+    assert keying.engine_epoch() != base
+    monkeypatch.delenv("RACON_TPU_MAP_K")
+    monkeypatch.setenv("RACON_TPU_MAP_DEVICE_SEED", "1")
+    monkeypatch.setenv("RACON_TPU_SERVE_MAP_MBPS", "99")
+    assert keying.engine_epoch() == base
+
+
+# ---------------------------------------------------------------------------
+# simulated-scenario quality (reads + draft only, no PAF consumed)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ds_tmp():
+    with tempfile.TemporaryDirectory(prefix="rtovl_",
+                                     dir="/tmp") as d:
+        yield d
+
+
+@pytest.fixture(scope="module")
+def dataset(ds_tmp):
+    from racon_tpu.tools import simulate
+
+    return simulate.simulate(os.path.join(ds_tmp, "data"),
+                             genome_len=8_000, coverage=5,
+                             read_len=800, seed=21, ont=True)
+
+
+def test_mapper_recall_precision_vs_truth(dataset, ds_tmp):
+    reads, _paf, draft = dataset
+    with open(os.path.join(ds_tmp, "data", "truth.json")) as fh:
+        truth = json.load(fh)
+    overlaps, stats = map_files(reads, draft)
+    by_name = {}
+    for o in overlaps:
+        by_name.setdefault(o.q_name, []).append(o)
+    hit = 0
+    emitted_good = 0
+    emitted = stats["overlaps"]
+    for rec in truth["reads"]:
+        want_strand = rec["strand"] == "-"
+        for o in by_name.get(rec["name"], []):
+            inter = (min(o.t_end, rec["t_end"])
+                     - max(o.t_begin, rec["t_begin"]))
+            span = rec["t_end"] - rec["t_begin"]
+            if o.strand == want_strand and inter >= 0.5 * span:
+                hit += 1
+                emitted_good += 1
+                break
+    recall = hit / len(truth["reads"])
+    precision = emitted_good / max(1, emitted)
+    assert recall >= 0.95, f"recall {recall:.3f}"
+    assert precision >= 0.90, f"precision {precision:.3f}"
+
+
+def _polish(reads, overlaps, draft, rounds=1):
+    from racon_tpu.core.polisher import PolisherType
+
+    polished, pol = polish_rounds(
+        reads, overlaps, draft, PolisherType.kC, 500, 10.0, 0.3,
+        False, 3, -5, -4, 1, rounds=rounds)
+    report = pol.rounds_report
+    pol.close()
+    fasta = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                     for s in polished)
+    return fasta, polished, report
+
+
+def test_internal_map_polish_matches_golden_paf(dataset):
+    """Mapper-discovered overlaps polish to within 2% edit distance
+    of the golden-PAF run (the acceptance bar)."""
+    from racon_tpu.ops.cpu import edit_distance
+
+    reads, paf, draft = dataset
+    internal, _, _ = _polish(reads, None, draft)
+    golden, polished, _ = _polish(reads, paf, draft)
+    assert internal.startswith(b">")
+    d = edit_distance(internal.split(b"\n")[1],
+                      golden.split(b"\n")[1])
+    ratio = d / len(polished[0].data)
+    assert ratio <= 0.02, f"edit distance ratio {ratio:.4f}"
+
+
+def test_two_round_byte_determinism(dataset):
+    reads, _paf, draft = dataset
+    a, _, rep_a = _polish(reads, None, draft, rounds=2)
+    b, _, rep_b = _polish(reads, None, draft, rounds=2)
+    assert a == b
+    assert len(rep_a) == 2 and len(rep_b) == 2
+    assert [r["overlaps"] for r in rep_a] == \
+        [r["overlaps"] for r in rep_b]
+    assert all(r["map_s"] > 0 for r in rep_a)
+
+
+def test_round2_cache_hits_on_converged_draft(dataset, ds_tmp):
+    """The designed round synergy: windows whose content did not move
+    between rounds digest identically and re-serve from the cache.
+    Polishing converges to a byte fixed point after two iterations on
+    this dataset; from the fixed-point draft, round 2's units are
+    exactly round 1's, so EVERY unit hits."""
+    reads, _paf, draft = dataset
+    _, polished, _ = _polish(reads, None, draft, rounds=2)
+    fixed = os.path.join(ds_tmp, "fixed.fasta")
+    write_fasta(fixed, polished)
+    out, _, report = _polish(reads, None, fixed, rounds=2)
+    assert out.startswith(b">")
+    assert report[1]["cache_hit"] > 0, report
+
+
+# ---------------------------------------------------------------------------
+# served: missing_overlaps reject + --rounds 2 byte identity
+# ---------------------------------------------------------------------------
+
+def _serve_env(ds_tmp, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "RACON_TPU_CACHE_DIR": os.path.join(ds_tmp, "cache"),
+        "RACON_TPU_CLI_PREWARM": "0",
+        "RACON_TPU_RATE_POA_DEV": "0.30",
+        "RACON_TPU_RATE_POA_CPU": "2.0",
+        "RACON_TPU_RATE_ALIGN_DEV": "1100",
+        "RACON_TPU_RATE_ALIGN_CPU": "4.0",
+        "RACON_TPU_RATE_ALIGN_WFA_DEV": "700",
+        "RACON_TPU_RATE_ALIGN_WFA_CPU": "1.0",
+    })
+    env.pop("RACON_TPU_TRACE", None)
+    env.pop("RACON_TPU_METRICS_JSON", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+@pytest.fixture(scope="module")
+def map_server(ds_tmp):
+    from racon_tpu.serve import client
+
+    sock_path = os.path.join(ds_tmp, "map.sock")
+    log = open(os.path.join(ds_tmp, "map.log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve",
+         "--socket", sock_path],
+        cwd=REPO_ROOT, stdout=log, stderr=log,
+        env=_serve_env(ds_tmp))
+    deadline = time.monotonic() + 120
+    up = False
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            log.close()
+            raise AssertionError("server died at startup: "
+                                 + open(log.name).read())
+        if os.path.exists(sock_path):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.connect(sock_path)
+            except OSError:
+                pass
+            else:
+                up = True
+            finally:
+                probe.close()
+            if up:
+                break
+        time.sleep(0.2)
+    log.close()
+    if not up:
+        proc.kill()
+        raise AssertionError("server socket never came up")
+    yield proc, sock_path
+    if proc.poll() is None:
+        try:
+            client.admin(sock_path, "shutdown")
+        except client.ServeError:
+            proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_served_missing_overlaps_structured_reject(map_server,
+                                                   dataset):
+    from racon_tpu.serve import client
+
+    _, sock_path = map_server
+    reads, _paf, draft = dataset
+    resp = client.submit(sock_path, {"sequences": reads,
+                                     "targets": draft,
+                                     "overlaps": None})
+    assert not resp.get("ok")
+    err = resp["error"]
+    assert err["code"] == "missing_overlaps"
+    assert "--rounds" in err.get("hint", "")
+    # opting in with rounds=1 turns the same spec into a mapped job
+    resp2 = client.submit(sock_path, {"sequences": reads,
+                                      "targets": draft,
+                                      "overlaps": None, "rounds": 1,
+                                      "threads": 2})
+    assert resp2.get("ok"), resp2.get("error")
+    assert base64.b64decode(resp2["fasta_b64"]).startswith(b">")
+    # admission priced the map stage
+    assert resp2["estimate"].get("map_s", 0) > 0
+
+
+def test_served_bad_rounds_rejected(map_server, dataset):
+    from racon_tpu.serve import client
+
+    _, sock_path = map_server
+    reads, _paf, draft = dataset
+    for bad in (0, -1, 99, "two", True):
+        resp = client.submit(sock_path, {"sequences": reads,
+                                         "targets": draft,
+                                         "overlaps": None,
+                                         "rounds": bad})
+        assert not resp.get("ok")
+        assert resp["error"]["code"] == "bad_request"
+
+
+def test_served_rounds2_byte_identical_to_cli(map_server, dataset,
+                                              ds_tmp):
+    """``submit reads draft --rounds 2`` (no PAF) against a live
+    daemon == standalone CLI bytes, with round 2 re-serving units
+    from the warm cache on a converged draft."""
+    from racon_tpu.serve import client
+
+    _, sock_path = map_server
+    reads, _paf, draft = dataset
+    _, polished, _ = _polish(reads, None, draft, rounds=2)
+    fixed = os.path.join(ds_tmp, "fixed_srv.fasta")
+    write_fasta(fixed, polished)
+
+    run = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "2",
+         "--rounds", "2", reads, fixed],
+        cwd=REPO_ROOT, capture_output=True,
+        env=_serve_env(ds_tmp), timeout=600)
+    assert run.returncode == 0, run.stderr.decode()
+    golden = run.stdout
+    assert golden.startswith(b">")
+
+    resp = client.submit(sock_path, {"sequences": reads,
+                                     "targets": fixed,
+                                     "overlaps": None, "rounds": 2,
+                                     "threads": 2})
+    assert resp.get("ok"), resp.get("error")
+    assert base64.b64decode(resp["fasta_b64"]) == golden
+    rounds_rep = resp["report"]["details"]["rounds"]
+    assert len(rounds_rep) == 2
+    assert rounds_rep[1]["cache_hit"] > 0, rounds_rep
+    assert all(r["map_s"] > 0 for r in rounds_rep)
+    # the estimate was scaled by the round count
+    assert resp["estimate"].get("rounds") == 2
+
+
+def test_client_spec_two_inputs_requests_mapping(dataset):
+    from racon_tpu.cli import parse_args
+    from racon_tpu.serve import client
+
+    reads, paf, draft = dataset
+    opts, _ = parse_args(["-t", "2", "--rounds", "2", reads, draft])
+    spec = client.spec_from_opts(opts, [reads, draft])
+    assert spec["overlaps"] is None
+    assert spec["rounds"] == 2
+    opts2, _ = parse_args(["-t", "2", reads, paf, draft])
+    spec2 = client.spec_from_opts(opts2, [reads, paf, draft])
+    assert spec2["overlaps"] == os.path.abspath(paf)
+    assert "rounds" not in spec2
+
+
+def test_wrapper_round_keys_and_specs(dataset, ds_tmp):
+    """The wrapper's served rounds loop: per-round content-digest
+    journal keys share the base digest (sketch affinity) and differ
+    only by the round suffix; round-1 specs carry the user's
+    overlaps, later rounds request internal mapping and keep
+    unpolished targets alive until the final round."""
+    from racon_tpu.tools.wrapper import Wrapper, build_arg_parser
+
+    reads, _paf, draft = dataset
+    args = build_arg_parser().parse_args(
+        [reads, draft, "--rounds", "3", "-u"])
+    assert args.target_sequences is None and args.rounds == 3
+    w = Wrapper(reads, None, draft, None, None, True, False,
+                500, 10.0, 0.3, 5, -4, -8, 1, 0, 0, False,
+                rounds=3)
+    w.subsampled_sequences = w.sequences
+    s1 = w._round_spec(draft, first=True, final=False)
+    s2 = w._round_spec(draft, first=False, final=False)
+    s3 = w._round_spec(draft, first=False, final=True)
+    assert s1["overlaps"] is None and s1["rounds"] == 1
+    assert s2["overlaps"] is None
+    assert not s1["drop_unpolished"] and not s2["drop_unpolished"]
+    assert s3["drop_unpolished"] is False  # -u keeps unpolished
+    k1 = w._chunk_job_key(s1, draft)
+    assert w._chunk_job_key(s1, draft) == k1  # content-stable
+    keys = [f"{k1}-round-{i}" for i in (1, 2, 3)]
+    assert len(set(keys)) == 3
+    assert all(k.startswith(k1) for k in keys)
+
+
+def test_wrapper_rounds_subprocess_matches_cli(dataset, ds_tmp):
+    """Wrapper --rounds without --server forwards to the CLI child:
+    bytes equal a direct CLI --rounds run."""
+    reads, _paf, draft = dataset
+    env = _serve_env(ds_tmp)
+    cli = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "2",
+         "-m", "5", "-x", "-4", "-g", "-8", "--rounds", "2",
+         reads, draft],
+        cwd=REPO_ROOT, capture_output=True, env=env, timeout=600)
+    assert cli.returncode == 0, cli.stderr.decode()
+    # cwd is the sandbox (the wrapper scratches its work directory
+    # in cwd), so the repo needs to be on the child's import path
+    wenv = dict(env, PYTHONPATH=REPO_ROOT)
+    wrap = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.tools.wrapper", "-t", "2",
+         "--rounds", "2", reads, draft],
+        cwd=ds_tmp, capture_output=True, env=wenv, timeout=600)
+    assert wrap.returncode == 0, wrap.stderr.decode()
+    assert wrap.stdout == cli.stdout
+
+
+def test_cli_two_positionals_and_rounds(dataset, ds_tmp):
+    """CLI accepts ``run reads draft`` (no PAF) and --rounds N; the
+    2-round output differs from the 1-round output (it did re-map)."""
+    reads, _paf, draft = dataset
+    env = _serve_env(ds_tmp)
+    one = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "run", "-t", "2",
+         reads, draft],
+        cwd=REPO_ROOT, capture_output=True, env=env, timeout=600)
+    assert one.returncode == 0, one.stderr.decode()
+    assert one.stdout.startswith(b">")
+    assert b" map " in one.stderr or b"map" in one.stderr
+    two = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "-t", "2",
+         "--rounds", "2", reads, draft],
+        cwd=REPO_ROOT, capture_output=True, env=env, timeout=600)
+    assert two.returncode == 0, two.stderr.decode()
+    assert two.stdout.startswith(b">")
+    assert two.stdout != one.stdout
